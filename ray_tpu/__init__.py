@@ -1,0 +1,69 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Capability-equivalent of Ray 2.39 (+ pluggable external scheduling) rebuilt
+idiomatically for TPU: a task/actor/object core runtime for host-side
+orchestration, with in-program parallelism (DP/FSDP/TP/PP/SP/EP/CP) expressed
+as JAX/XLA constructs — pjit shardings over device meshes, XLA collectives
+over ICI/DCN, Pallas kernels for the hot ops — instead of NCCL process groups.
+
+Public surface mirrors the reference's `ray.*` top level
+(reference: python/ray/__init__.py).
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    free,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    kv_del,
+    kv_get,
+    kv_keys,
+    kv_put,
+    list_named_actors,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.actor import ActorClass, ActorHandle, method
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu import exceptions
+
+__all__ = [
+    "__version__",
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "free",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "kv_del",
+    "kv_get",
+    "kv_keys",
+    "kv_put",
+    "list_named_actors",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

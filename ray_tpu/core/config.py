@@ -1,0 +1,163 @@
+"""Typed, env-overridable configuration registry.
+
+Equivalent capability to the reference's RAY_CONFIG system
+(reference: src/ray/common/ray_config_def.h — 218 tunables, env override via
+``RAY_<name>``, per-run override via ``init(_system_config=...)``, distributed
+from the control service to every node). Here:
+
+- defaults declared once in ``_DEFINITIONS``
+- env override: ``RAY_TPU_<NAME>`` (bools: 0/1/true/false)
+- programmatic override: ``config.apply_overrides({...})`` (called by
+  ``ray_tpu.init(system_config=...)``); the head node publishes the merged
+  dict through the control service so every node agent/worker sees one view.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class _ConfigEntry:
+    name: str
+    default: Any
+    type: type
+    doc: str = ""
+
+
+def _parse(raw: str, typ: type) -> Any:
+    if typ is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    if typ is dict or typ is list:
+        return json.loads(raw)
+    return typ(raw)
+
+
+class Config:
+    """Process-wide config. Thread-safe; values resolve as
+    override > environment > default."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _ConfigEntry] = {}
+        self._overrides: Dict[str, Any] = {}
+        for name, default, typ, doc in _DEFINITIONS:
+            self._entries[name] = _ConfigEntry(name, default, typ, doc)
+
+    def get(self, name: str) -> Any:
+        entry = self._entries[name]
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+        raw = os.environ.get(_ENV_PREFIX + name.upper())
+        if raw is not None:
+            try:
+                return _parse(raw, entry.type)
+            except (ValueError, json.JSONDecodeError):
+                pass
+        return entry.default
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def apply_overrides(self, overrides: Optional[Dict[str, Any]]) -> None:
+        if not overrides:
+            return
+        unknown = [k for k in overrides if k not in self._entries]
+        if unknown:
+            raise ValueError(f"Unknown config keys: {unknown}. Known: {sorted(self._entries)}")
+        with self._lock:
+            self._overrides.update(overrides)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Resolved view of every entry (for distribution to other nodes)."""
+        return {name: self.get(name) for name in self._entries}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._overrides.clear()
+
+
+# (name, default, type, doc)
+_DEFINITIONS = [
+    # --- object store / object plane ---
+    ("object_store_memory_bytes", 2 * 1024**3, int,
+     "Shared-memory object store arena size per node."),
+    ("object_store_full_retries", 10, int,
+     "Retries (with eviction attempts) before a put fails with ObjectStoreFullError."),
+    ("max_direct_call_object_size", 100 * 1024, int,
+     "Task returns under this size are sent inline to the owner instead of the shared store."),
+    ("object_spilling_enabled", True, bool,
+     "Spill primary copies to local disk under memory pressure."),
+    ("object_spilling_dir", "", str,
+     "Directory for spilled objects; defaults to <session_dir>/spill."),
+    ("object_spilling_threshold", 0.8, float,
+     "Arena utilization fraction that triggers spilling."),
+    ("fetch_chunk_bytes", 8 * 1024 * 1024, int,
+     "Chunk size for node-to-node object transfer."),
+    ("object_transfer_retries", 5, int,
+     "Pull retries (exponential backoff) before an object fetch errors."),
+    # --- scheduling ---
+    ("scheduler_spread_threshold", 0.5, float,
+     "Hybrid policy: pack onto nodes below this utilization, then spread."),
+    ("scheduler_top_k_fraction", 0.2, float,
+     "Hybrid policy samples among the top-k fraction of feasible nodes."),
+    ("external_scheduler_address", "", str,
+     "host:port of an external placement-policy service (batched, off the per-task hot path)."),
+    ("external_scheduler_batch_ms", 10, int,
+     "Batching window for external scheduler placement requests."),
+    ("worker_lease_timeout_s", 30.0, float,
+     "Timeout for a worker-lease request before retrying elsewhere."),
+    ("max_pending_lease_requests_per_key", 10, int,
+     "Pipelined lease requests per scheduling key."),
+    # --- workers ---
+    ("num_workers_per_node", 0, int,
+     "Worker processes per node (0 = num_cpus)."),
+    ("worker_idle_timeout_s", 60.0, float,
+     "Idle leased workers are returned to the pool after this."),
+    ("worker_start_timeout_s", 60.0, float,
+     "Time to wait for a worker process to register before declaring it failed."),
+    ("prestart_workers", True, bool,
+     "Start workers ahead of demand based on queue backlog."),
+    # --- fault tolerance ---
+    ("task_max_retries_default", 3, int,
+     "Default retries for tasks that die due to worker/node failure."),
+    ("actor_max_restarts_default", 0, int,
+     "Default actor restarts."),
+    ("max_lineage_bytes", 512 * 1024 * 1024, int,
+     "Budget of task-spec lineage kept for object reconstruction."),
+    ("health_check_period_ms", 1000, int,
+     "Control-service health ping period."),
+    ("health_check_failure_threshold", 5, int,
+     "Missed health checks before a node is declared dead."),
+    # --- rpc ---
+    ("rpc_connect_timeout_s", 10.0, float, "Socket connect timeout."),
+    ("rpc_call_timeout_s", 60.0, float, "Default RPC deadline."),
+    ("rpc_max_message_bytes", 512 * 1024 * 1024, int, "Max framed message size."),
+    ("rpc_chaos_failure_prob", 0.0, float,
+     "Fault injection: probability an RPC is dropped (request or response)."),
+    ("rpc_chaos_seed", 0, int, "Seed for RPC chaos injection."),
+    # --- observability ---
+    ("metrics_export_port", 0, int, "Prometheus text exposition port (0=disabled)."),
+    ("event_log_enabled", True, bool, "Write task/actor state events to the session dir."),
+    ("log_to_driver", True, bool, "Forward worker stdout/stderr to the driver."),
+    # --- tpu / device ---
+    ("tpu_chips_per_host", 4, int, "Chips per TPU VM host (v4/v5p default 4)."),
+    ("ici_bandwidth_gbps", 100.0, float, "Per-link ICI bandwidth estimate for the cost model."),
+    ("dcn_bandwidth_gbps", 25.0, float, "Per-host DCN bandwidth estimate for the cost model."),
+    ("device_prefetch_depth", 2, int, "Host->HBM double-buffering depth for data loading."),
+]
+
+
+config = Config()

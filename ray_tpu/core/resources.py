@@ -1,0 +1,113 @@
+"""Resource sets and scheduling strategies.
+
+Reference capability: src/ray/common/scheduling/ (ResourceRequest,
+ResourceSet) + python/ray/util/scheduling_strategies.py. TPU additions:
+``TPU`` chips are a first-class resource alongside CPU/memory, and nodes carry
+ICI-topology labels (slice name, host index in slice, topology string) used by
+the placement-group policies for same-ICI-domain gang scheduling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+CPU = "CPU"
+TPU = "TPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+# Node labels (mirroring the reference's ray.io/* accelerator labels,
+# python/ray/_private/accelerators/tpu.py:155-220).
+LABEL_SLICE_NAME = "ray_tpu.io/slice-name"
+LABEL_SLICE_HOST_INDEX = "ray_tpu.io/slice-host-index"
+LABEL_TPU_TOPOLOGY = "ray_tpu.io/tpu-topology"
+LABEL_TPU_GENERATION = "ray_tpu.io/tpu-generation"
+LABEL_NODE_ID = "ray_tpu.io/node-id"
+
+
+def tpu_slice_head_resource(generation: str) -> str:
+    """Resource granted to host 0 of a slice; lets one actor gang-own a slice
+    (reference: TPU-{type}-head resource, accelerators/tpu.py)."""
+    return f"TPU-{generation}-head"
+
+
+class ResourceSet(dict):
+    """A {resource_name: quantity} multiset with arithmetic and feasibility."""
+
+    def __init__(self, items: Optional[Dict[str, float]] = None):
+        super().__init__()
+        for k, v in (items or {}).items():
+            if v:
+                self[k] = float(v)
+
+    def copy(self) -> "ResourceSet":
+        return ResourceSet(self)
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(other.get(k, 0.0) + 1e-9 >= v for k, v in self.items())
+
+    def add(self, other: Dict[str, float]) -> None:
+        for k, v in other.items():
+            self[k] = self.get(k, 0.0) + v
+            if abs(self[k]) < 1e-9:
+                del self[k]
+
+    def subtract(self, other: Dict[str, float]) -> None:
+        for k, v in other.items():
+            self[k] = self.get(k, 0.0) - v
+            if abs(self[k]) < 1e-9:
+                del self[k]
+
+    def utilization(self, total: "ResourceSet") -> float:
+        """Max over resources of used/total (used = total - self-as-available)."""
+        util = 0.0
+        for k, tot in total.items():
+            if tot <= 0:
+                continue
+            avail = self.get(k, 0.0)
+            util = max(util, (tot - avail) / tot)
+        return util
+
+
+@dataclass
+class SchedulingStrategy:
+    """Base: default hybrid pack-then-spread."""
+
+
+@dataclass
+class DefaultSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    """Round-robin over feasible nodes (reference: spread_scheduling_policy.h)."""
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    node_id: str = ""
+    soft: bool = False
+
+
+@dataclass
+class NodeLabelSchedulingStrategy(SchedulingStrategy):
+    hard: Dict[str, str] = field(default_factory=dict)
+    soft: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group: object = None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class SliceSchedulingStrategy(SchedulingStrategy):
+    """TPU-native: place onto hosts of one ICI slice (optionally a specific
+    slice by name). The gang analogue of STRICT_PACK for TPU pods."""
+
+    slice_name: str = ""
+    require_head: bool = False

@@ -1,0 +1,176 @@
+"""Object serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Capability-equivalent to the reference's serialization stack
+(reference: python/ray/_private/serialization.py + includes/serialization.pxi):
+
+- values are pickled with cloudpickle (protocol 5); large contiguous buffers
+  (numpy arrays, jax host arrays, arrow buffers, bytes) are extracted
+  out-of-band so the object store can hold them contiguously and readers can
+  reconstruct **zero-copy** views over shared memory;
+- ``ObjectRef``s contained inside a value are captured during serialization
+  (the borrowing hook) so the runtime can track nested references;
+- a custom-serializer registry mirrors ``ray.util.register_serializer``.
+
+Wire format of a stored object:
+    [u32 n_buffers][u64 meta_len][u64 len_0]...[u64 len_{n-1}][meta_pickle][buf_0]...[buf_n]
+with 64-byte alignment for each out-of-band buffer so numpy/jax views are
+aligned for vectorized readers.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+ALIGN = 64
+
+_custom_serializers: Dict[type, Tuple[Callable, Callable]] = {}
+_custom_lock = threading.Lock()
+
+# Thread-local capture of ObjectRefs encountered while pickling a value.
+_capture = threading.local()
+
+
+def register_serializer(cls: type, *, serializer: Callable, deserializer: Callable) -> None:
+    """Register a custom reducer for ``cls`` (like ray.util.register_serializer)."""
+    with _custom_lock:
+        _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: type) -> None:
+    with _custom_lock:
+        _custom_serializers.pop(cls, None)
+
+
+class _Pickler(cloudpickle.Pickler):
+    def reducer_override(self, obj: Any):
+        # ObjectRef capture hook: record and serialize by id.
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            captured = getattr(_capture, "refs", None)
+            if captured is not None:
+                captured.append(obj)
+            return (_reconstruct_ref, (obj.id.binary(), obj.owner_hint))
+        if _is_jax_array(obj):
+            # Device arrays travel as host numpy (out-of-band buffer) and are
+            # re-placed on the default device at load; explicit device
+            # placement is the caller's job (parallel/ channels move HBM-HBM).
+            import numpy as np
+
+            return (_reconstruct_jax, (np.asarray(obj), obj.dtype.name))
+        with _custom_lock:
+            entry = _custom_serializers.get(type(obj))
+        if entry is not None:
+            ser, deser = entry
+            return (_apply_deserializer, (deser, ser(obj)))
+        return NotImplemented
+
+
+def _apply_deserializer(deser: Callable, payload: Any) -> Any:
+    return deser(payload)
+
+
+def _reconstruct_ref(id_bytes: bytes, owner_hint: Optional[str]):
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_ref import ObjectRef
+    from ray_tpu.core.worker import maybe_register_borrowed_ref
+
+    ref = ObjectRef(ObjectID(id_bytes), owner_hint=owner_hint, _borrowed=True)
+    maybe_register_borrowed_ref(ref)
+    return ref
+
+
+def _reconstruct_jax(np_value: Any, dtype_name: str) -> Any:
+    import jax.numpy as jnp
+
+    return jnp.asarray(np_value, dtype=dtype_name)
+
+
+def _is_jax_array(obj: Any) -> bool:
+    mod = type(obj).__module__
+    return mod.startswith("jax") and type(obj).__name__ in ("ArrayImpl", "Array")
+
+
+def serialize(value: Any) -> Tuple[bytes, List["pickle.PickleBuffer"], List[Any]]:
+    """Serialize to (meta, oob_buffers, contained_refs).
+
+    jax.Arrays are converted to host numpy before pickling (device buffers
+    never travel through the host object store implicitly as anything else).
+    """
+    import io
+
+    buffers: List[pickle.PickleBuffer] = []
+    _capture.refs = []
+    try:
+        f = io.BytesIO()
+        pickler = _Pickler(f, protocol=5, buffer_callback=buffers.append)
+        pickler.dump(value)
+        meta = f.getvalue()
+        refs = list(_capture.refs)
+    finally:
+        _capture.refs = None
+    return meta, buffers, refs
+
+
+def pack(value: Any) -> Tuple[bytes, List[Any]]:
+    """Serialize and frame into one contiguous payload. Returns (payload, refs)."""
+    meta, buffers, refs = serialize(value)
+    raws = [b.raw() for b in buffers]
+    header = struct.pack("<IQ", len(raws), len(meta))
+    lens = b"".join(struct.pack("<Q", len(r)) for r in raws)
+    prefix_len = len(header) + len(lens) + len(meta)
+    parts = [header, lens, meta]
+    offset = prefix_len
+    for r in raws:
+        pad = (-offset) % ALIGN
+        parts.append(b"\x00" * pad)
+        offset += pad
+        parts.append(r)
+        offset += len(r)
+    return b"".join(parts), refs
+
+
+def packed_size(value: Any) -> int:
+    payload, _ = pack(value)
+    return len(payload)
+
+
+def unpack(payload: memoryview | bytes, zero_copy: bool = True) -> Any:
+    """Reconstruct a value from a framed payload.
+
+    With ``zero_copy=True`` and a memoryview over shared memory, numpy arrays
+    alias the store buffer (read-only), like plasma's zero-copy gets.
+    """
+    view = memoryview(payload)
+    n_buffers, meta_len = struct.unpack_from("<IQ", view, 0)
+    off = 12
+    lengths = []
+    for _ in range(n_buffers):
+        (ln,) = struct.unpack_from("<Q", view, off)
+        lengths.append(ln)
+        off += 8
+    meta = bytes(view[off : off + meta_len])
+    pos = off + meta_len
+    bufs = []
+    for ln in lengths:
+        pos += (-pos) % ALIGN
+        b = view[pos : pos + ln]
+        if not zero_copy:
+            b = memoryview(bytes(b))
+        bufs.append(b)
+        pos += ln
+    return pickle.loads(meta, buffers=bufs)
+
+
+def dumps(value: Any) -> bytes:
+    """Plain in-band pickle (for RPC payloads, small control messages)."""
+    return cloudpickle.dumps(value, protocol=5)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

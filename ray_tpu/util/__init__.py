@@ -1,0 +1,17 @@
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    get_current_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+__all__ = [
+    "ActorPool",
+    "PlacementGroup",
+    "get_current_placement_group",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+]

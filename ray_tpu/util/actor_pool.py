@@ -1,0 +1,87 @@
+"""ActorPool: round-robin work distribution over a fixed set of actors
+(reference capability: python/ray/util/actor_pool.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional, TypeVar
+
+from ray_tpu import api
+from ray_tpu.core.object_ref import ObjectRef
+
+V = TypeVar("V")
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def submit(self, fn: Callable[[Any, V], ObjectRef], value: V) -> None:
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = api.get(future, timeout=timeout)
+        _, actor = self._future_to_actor.pop(future)
+        self._return_actor(actor)
+        return value
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        ready, _ = api.wait(list(self._future_to_actor), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("Timed out waiting for result")
+        future = ready[0]
+        i, actor = self._future_to_actor.pop(future)
+        del self._index_to_future[i]
+        if i == self._next_return_index:
+            while self._next_return_index in self._future_to_actor:
+                self._next_return_index += 1
+            self._next_return_index = max(self._next_return_index, i + 1)
+        self._return_actor(actor)
+        return api.get(future)
+
+    def _return_actor(self, actor: Any) -> None:
+        self._idle.append(actor)
+        while self._pending_submits and self._idle:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def map(self, fn: Callable, values: Iterable[V]) -> Iterable[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[V]) -> Iterable[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor: Any) -> None:
+        self._return_actor(actor)

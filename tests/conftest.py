@@ -1,0 +1,33 @@
+import os
+
+# Configure JAX for a virtual 8-device CPU mesh BEFORE jax is imported
+# anywhere (the fake-TPU CI analogue: multi-chip logic runs on host devices).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest
+
+
+@pytest.fixture
+def ray_tpu_local():
+    """Fresh local runtime per test (analogue of the reference's
+    ray_start_regular fixture, python/ray/tests/conftest.py:419)."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    import ray_tpu
+
+    yield ray_tpu
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()

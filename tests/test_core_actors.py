@@ -1,0 +1,198 @@
+"""Actor tests (modeled on the reference's python/ray/tests/test_actor.py)."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get_value(self):
+        return self.value
+
+
+def test_actor_basic(ray_tpu_local):
+    c = Counter.remote()
+    assert ray_tpu.get(c.increment.remote()) == 1
+    assert ray_tpu.get(c.increment.remote(5)) == 6
+    assert ray_tpu.get(c.get_value.remote()) == 6
+
+
+def test_actor_init_args(ray_tpu_local):
+    c = Counter.remote(start=100)
+    assert ray_tpu.get(c.get_value.remote()) == 100
+
+
+def test_actor_ordering(ray_tpu_local):
+    c = Counter.remote()
+    refs = [c.increment.remote() for _ in range(50)]
+    values = ray_tpu.get(refs)
+    assert values == list(range(1, 51))
+
+
+def test_actor_method_error(ray_tpu_local):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("method error")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="method error"):
+        ray_tpu.get(b.fail.remote())
+    # actor survives user exceptions
+    assert ray_tpu.get(b.ok.remote()) == "fine"
+
+
+def test_actor_init_failure(ray_tpu_local):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("ctor boom")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((exceptions.TaskError, exceptions.ActorDiedError, ValueError)):
+        ray_tpu.get(b.m.remote(), timeout=10)
+
+
+def test_kill_actor(ray_tpu_local):
+    c = Counter.remote()
+    assert ray_tpu.get(c.increment.remote()) == 1
+    ray_tpu.kill(c)
+    time.sleep(0.2)
+    with pytest.raises(exceptions.ActorDiedError):
+        ray_tpu.get(c.increment.remote(), timeout=10)
+
+
+def test_named_actor(ray_tpu_local):
+    Counter.options(name="global_counter").remote(start=7)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.get_value.remote()) == 7
+    assert "global_counter" in ray_tpu.list_named_actors()
+
+
+def test_named_actor_duplicate_rejected(ray_tpu_local):
+    Counter.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_actor_missing(ray_tpu_local):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does_not_exist")
+
+
+def test_actor_handle_passing(ray_tpu_local):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.increment.remote())
+
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.get_value.remote()) == 1
+
+
+def test_async_actor(ray_tpu_local):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def process(self, x):
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncWorker.options(max_concurrency=4).remote()
+    refs = [a.process.remote(i) for i in range(8)]
+    assert sorted(ray_tpu.get(refs)) == [i * 2 for i in range(8)]
+
+
+def test_threaded_actor_concurrency(ray_tpu_local):
+    @ray_tpu.remote
+    class Slow:
+        def work(self):
+            time.sleep(0.3)
+            return 1
+
+    a = Slow.options(max_concurrency=4).remote()
+    start = time.monotonic()
+    ray_tpu.get([a.work.remote() for _ in range(4)])
+    elapsed = time.monotonic() - start
+    assert elapsed < 1.0, f"concurrent calls should overlap, took {elapsed}s"
+
+
+def test_actor_resources_held(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote(num_cpus=2)
+    class Heavy:
+        def ping(self):
+            return "pong"
+
+    h = Heavy.remote()
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+    assert ray_tpu.available_resources().get("CPU", 0) == 2.0
+    ray_tpu.kill(h)
+    time.sleep(0.3)
+    assert ray_tpu.available_resources().get("CPU", 0) == 4.0
+
+
+def test_actor_num_returns_option(ray_tpu_local):
+    @ray_tpu.remote
+    class Multi:
+        def pair(self):
+            return 1, 2
+
+    m = Multi.remote()
+    r1, r2 = m.pair.options(num_returns=2).remote()
+    assert ray_tpu.get([r1, r2]) == [1, 2]
+
+
+def test_actor_call_with_objectref_arg(ray_tpu_local):
+    """Actor methods receive resolved values for ObjectRef args (code-review
+    regression: raw refs used to be passed through)."""
+
+    @ray_tpu.remote
+    def produce():
+        return 41
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.increment.remote(produce.remote())) == 41
+    assert ray_tpu.get(c.increment.remote(ray_tpu.put(1))) == 42
+
+
+def test_async_actor_context_isolation(ray_tpu_local):
+    """Concurrent async calls keep distinct task contexts (contextvars)."""
+
+    @ray_tpu.remote
+    class Ctx:
+        async def tid(self):
+            await asyncio.sleep(0.05)
+            return ray_tpu.get_runtime_context().get_task_id()
+
+    a = Ctx.options(max_concurrency=4).remote()
+    tids = ray_tpu.get([a.tid.remote() for _ in range(4)])
+    assert len(set(tids)) == 4 and all(tids)
+
+
+def test_duplicate_name_does_not_leak_actor(ray_tpu_local):
+    Counter.options(name="leak_check").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="leak_check").remote()
+    # the rejected actor must not shadow the original
+    h = ray_tpu.get_actor("leak_check")
+    assert ray_tpu.get(h.increment.remote()) == 1

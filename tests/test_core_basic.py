@@ -1,0 +1,275 @@
+"""Core API tests: put/get/wait, tasks, errors, dependencies.
+
+Modeled on the reference's python/ray/tests/test_basic*.py coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def test_put_get(ray_tpu_local):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_numpy(ray_tpu_local):
+    arr = np.arange(1000, dtype=np.float32).reshape(10, 100)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_put_objectref_rejected(ray_tpu_local):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_simple_task(ray_tpu_local):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_kwargs_and_options(ray_tpu_local):
+    @ray_tpu.remote(num_cpus=2)
+    def f(a, b=10):
+        return a * b
+
+    assert ray_tpu.get(f.remote(3)) == 30
+    assert ray_tpu.get(f.options(name="custom").remote(2, b=5)) == 10
+
+
+def test_task_multiple_returns(ray_tpu_local):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_task_dependency_chain(ray_tpu_local):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_task_error_propagates(ray_tpu_local):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bad")
+
+    with pytest.raises(ValueError, match="bad"):
+        ray_tpu.get(boom.remote())
+
+
+def test_dependent_task_fails_with_parent_error(ray_tpu_local):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("inner")
+
+    @ray_tpu.remote
+    def use(x):
+        return x
+
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(use.remote(boom.remote()))
+
+
+def test_retry_exceptions(ray_tpu_local):
+    counter = {"n": 0}
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        counter["n"] += 1
+        if counter["n"] < 3:
+            raise RuntimeError("transient")
+        return counter["n"]
+
+    assert ray_tpu.get(flaky.remote()) == 3
+
+
+def test_get_timeout(ray_tpu_local):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(exceptions.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(ray_tpu_local):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(5)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=2)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_wait_all(ray_tpu_local):
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(5)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=5, timeout=10)
+    assert len(ready) == 5 and not not_ready
+
+
+def test_nested_object_refs(ray_tpu_local):
+    inner = ray_tpu.put("inner-value")
+
+    @ray_tpu.remote
+    def unwrap(container):
+        # container holds a borrowed ObjectRef
+        return ray_tpu.get(container["ref"])
+
+    assert ray_tpu.get(unwrap.remote({"ref": inner})) == "inner-value"
+
+
+def test_cancel_pending_task(ray_tpu_local):
+    @ray_tpu.remote(num_cpus=8)
+    def hog():
+        time.sleep(10)
+        return 1
+
+    @ray_tpu.remote(num_cpus=8)
+    def queued():
+        return 2
+
+    h = hog.remote()
+    q = queued.remote()  # blocked: hog holds all CPUs
+    time.sleep(0.1)
+    ray_tpu.cancel(q)
+    with pytest.raises(exceptions.TaskCancelledError):
+        ray_tpu.get(q, timeout=5)
+    ray_tpu.cancel(h)
+
+
+def test_resource_accounting(ray_tpu_local):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 8.0
+
+    import threading
+
+    release = threading.Event()
+
+    @ray_tpu.remote(num_cpus=4)
+    def hold():
+        release.wait(10)
+        return 1
+
+    ref = hold.remote()
+    time.sleep(0.2)
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) == 4.0
+    release.set()
+    ray_tpu.get(ref)
+
+
+def test_custom_resources(shutdown_only):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, resources={"widget": 2})
+
+    @ray_tpu.remote(resources={"widget": 1})
+    def use_widget():
+        return "ok"
+
+    assert ray_tpu.get(use_widget.remote()) == "ok"
+    with pytest.raises(ValueError):
+
+        @ray_tpu.remote(resources={"widget": 5})
+        def too_many():
+            return None
+
+        too_many.remote()
+
+
+def test_num_returns_mismatch_errors(ray_tpu_local):
+    @ray_tpu.remote(num_returns=2)
+    def wrong():
+        return 1
+
+    r1, r2 = wrong.remote()
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(r1)
+
+
+def test_kv_api(ray_tpu_local):
+    ray_tpu.kv_put("k1", b"v1")
+    ray_tpu.kv_put("k2", b"v2")
+    assert ray_tpu.kv_get("k1") == b"v1"
+    assert sorted(ray_tpu.kv_keys("k")) == ["k1", "k2"]
+    ray_tpu.kv_del("k1")
+    assert ray_tpu.kv_get("k1") is None
+
+
+def test_nodes_and_context(ray_tpu_local):
+    nodes = ray_tpu.nodes()
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_node_id() == nodes[0]["NodeID"]
+
+    @ray_tpu.remote
+    def whoami():
+        c = ray_tpu.get_runtime_context()
+        return c.get_task_id()
+
+    tid = ray_tpu.get(whoami.remote())
+    assert tid and tid != ctx.get_task_id()
+
+
+def test_cancel_then_get_never_hangs(ray_tpu_local):
+    """Cancel racing the dispatcher must still seal returns (review regression)."""
+
+    @ray_tpu.remote(num_cpus=8)
+    def hog():
+        time.sleep(3)
+
+    @ray_tpu.remote(num_cpus=1)
+    def victim():
+        return 1
+
+    h = hog.remote()
+    refs = [victim.remote() for _ in range(20)]
+    for r in refs:
+        ray_tpu.cancel(r)
+    for r in refs:
+        try:
+            ray_tpu.get(r, timeout=5)
+        except (exceptions.TaskCancelledError, exceptions.GetTimeoutError) as e:
+            assert not isinstance(e, exceptions.GetTimeoutError), "get() hung on cancelled task"
+    ray_tpu.cancel(h)
+
+
+def test_pg_bundle_index_out_of_range(ray_tpu_local):
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}])
+
+    @ray_tpu.remote(placement_group=pg, placement_group_bundle_index=5)
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="out of range"):
+        f.remote()
