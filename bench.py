@@ -1,0 +1,124 @@
+"""Benchmark harness: Llama train-step tokens/sec/chip.
+
+Prints ONE JSON line:
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
+
+The reference publishes no LLM-scale numbers (BASELINE.md), so
+``vs_baseline`` is measured throughput relative to a 40%-MFU roofline target
+for the detected chip — vs_baseline >= 1.0 means the train step sustains at
+least 40% of peak matmul FLOPs, a strong result for a dense decoder step.
+On CPU (no TPU attached) a tiny config still runs so the harness always
+emits a valid line; the roofline is then nominal.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+# Peak dense bf16 FLOPs per chip by device-kind substring.
+PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+MFU_TARGET = 0.40
+
+
+def detect_chip():
+    import jax
+
+    devs = jax.devices()
+    tpus = [d for d in devs if d.platform == "tpu"]
+    if not tpus:
+        return None, "cpu", 1e12
+    kind = (getattr(tpus[0], "device_kind", "") or "tpu").lower()
+    for key, flops in PEAK_FLOPS:
+        if key in kind:
+            return tpus[0], kind, flops
+    return tpus[0], kind, 275e12
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.train.step import default_optimizer, make_train_state_factory, make_train_step
+
+    device, kind, peak = detect_chip()
+    on_tpu = device is not None
+
+    if on_tpu:
+        config = LlamaConfig.llama_1b(
+            max_seq_len=2048, remat="nothing_saveable", attention_impl="flash"
+        )
+        batch, seq, steps, warmup = 8, 2048, 20, 3
+    else:
+        config = LlamaConfig.tiny(dtype=jnp.float32, remat=None, attention_impl="reference")
+        batch, seq, steps, warmup = 4, 128, 5, 2
+
+    opt = default_optimizer(warmup_steps=10, total_steps=1000)
+    init = make_train_state_factory(config, opt)
+    step = make_train_step(config, opt, donate=True)
+
+    state = init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    # warmup (compile). NOTE: jax.block_until_ready does not reliably sync on
+    # the tunneled "axon" platform — device_get is the hard sync.
+    for _ in range(warmup):
+        state, metrics = step(state, tokens, targets)
+    jax.device_get(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, tokens, targets)
+    final_loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+
+    n_params = config.num_params
+    # FLOPs/token: 6N for weights (fwd+bwd) + attention 12*L*h*s (causal ~1/2)
+    flops_per_token = 6 * n_params + 6 * config.num_layers * config.hidden_size * seq
+    mfu = tokens_per_sec * flops_per_token / peak
+    target_tps = MFU_TARGET * peak / flops_per_token
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / target_tps, 4),
+        "mfu": round(mfu, 4),
+        "chip": kind,
+        "model_params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "loss": round(final_loss, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 - the driver needs a JSON line no matter what
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0,
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        sys.exit(0)

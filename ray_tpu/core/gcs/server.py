@@ -1,0 +1,511 @@
+"""GCS — the cluster control service (the "brain").
+
+Reference capability: src/ray/gcs/gcs_server/ (GcsServer::Start wiring
+gcs_server.cc:138-232 — node manager, KV, actor manager + scheduler,
+placement groups, health checks, job manager, pubsub) re-designed for a
+TPU-cluster control plane:
+
+- node membership + per-node resource/label view (TPU slice labels included)
+- global placement: hybrid pack/spread, SPREAD, node-affinity, label match,
+  placement-group bundles (PACK/SPREAD/STRICT_*), slice-aware strategies,
+  and the **external policy hook** — the fork's capability
+  (external_scheduler/scheduler.py + external_scheduler.cc) kept OFF the
+  per-task hot path: requests are batched per scheduling tick and the
+  external service answers with placements asynchronously
+- actor directory with restart bookkeeping, named-actor registry
+- object directory (location set per object; owner + size metadata)
+- KV store (function table, runtime env URIs, cluster config)
+- pubsub channels: "nodes", "actors", "actor:<hex>", "objects:<hex>"
+- health: agents heartbeat; misses beyond threshold mark the node dead and
+  trigger actor failover + location cleanup.
+
+Single asyncio process; storage is in-memory (the Redis-backed persistence
+tier of the reference maps to a snapshot/journal TODO, recorded in docs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core.config import config
+from ray_tpu.core.rpc import RpcServer
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("gcs")
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.rpc = RpcServer(host, port)
+        self.rpc.register_object(self)
+        # node_id(hex) -> info dict
+        self.nodes: Dict[str, Dict[str, Any]] = {}
+        # available resources per node (updated by heartbeats)
+        self.available: Dict[str, Dict[str, float]] = {}
+        self.last_heartbeat: Dict[str, float] = {}
+        self.kv: Dict[str, bytes] = {}
+        # actors: actor_id hex -> record
+        self.actors: Dict[str, Dict[str, Any]] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}
+        # objects: object_id hex -> {size, locations: set, owner}
+        self.objects: Dict[str, Dict[str, Any]] = {}
+        # placement groups: pg hex -> {bundles, strategy, name, placement: [node hex]}
+        self.pgs: Dict[str, Dict[str, Any]] = {}
+        # per-node, per-pg-bundle reservations: node hex -> resources dict
+        self._spread_rr = 0
+        self._job_counter = 1
+        self._health_task: Optional[asyncio.Task] = None
+        self._external: Optional["ExternalPolicyClient"] = None
+        self._started_at = time.time()
+
+    async def start(self) -> Tuple[str, int]:
+        host, port = await self.rpc.start()
+        if config.external_scheduler_address:
+            from ray_tpu.core.gcs.external_policy import ExternalPolicyClient
+
+            self._external = ExternalPolicyClient(config.external_scheduler_address)
+            await self._external.start()
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        logger.info("GCS listening on %s:%d", host, port)
+        return host, port
+
+    async def stop(self) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+        if self._external:
+            await self._external.stop()
+        await self.rpc.stop()
+
+    # ------------------------------------------------------------- node table
+    async def rpc_register_node(
+        self,
+        node_id: str,
+        address: str,
+        resources: Dict[str, float],
+        labels: Dict[str, str],
+        is_head: bool = False,
+    ) -> Dict[str, Any]:
+        self.nodes[node_id] = {
+            "NodeID": node_id,
+            "NodeManagerAddress": address,
+            "Resources": dict(resources),
+            "Labels": dict(labels),
+            "Alive": True,
+            "is_head": is_head,
+            "registered_at": time.time(),
+        }
+        self.available[node_id] = dict(resources)
+        self.last_heartbeat[node_id] = time.monotonic()
+        if self._external:
+            self._external.add_node(node_id, resources)
+        await self.rpc.publish("nodes", {"event": "register", "node": self.nodes[node_id]})
+        return {"system_config": dict_config_snapshot()}
+
+    async def rpc_heartbeat(
+        self, node_id: str, available: Dict[str, float], load: Optional[Dict[str, Any]] = None
+    ) -> bool:
+        if node_id not in self.nodes:
+            return False  # node must re-register (GCS restarted)
+        self.available[node_id] = dict(available)
+        self.last_heartbeat[node_id] = time.monotonic()
+        return True
+
+    async def rpc_drain_node(self, node_id: str) -> bool:
+        await self._mark_node_dead(node_id, "drained")
+        return True
+
+    async def rpc_get_nodes(self) -> List[Dict[str, Any]]:
+        return list(self.nodes.values())
+
+    async def rpc_cluster_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for info in self.nodes.values():
+            if not info["Alive"]:
+                continue
+            for k, v in info["Resources"].items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+    async def rpc_available_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for node_id, avail in self.available.items():
+            if not self.nodes.get(node_id, {}).get("Alive"):
+                continue
+            for k, v in avail.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+    async def _health_loop(self) -> None:
+        period = config.health_check_period_ms / 1000.0
+        threshold = config.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, info in list(self.nodes.items()):
+                if not info["Alive"]:
+                    continue
+                if now - self.last_heartbeat.get(node_id, now) > period * threshold:
+                    logger.warning("node %s missed heartbeats; marking dead", node_id[:8])
+                    await self._mark_node_dead(node_id, "missed heartbeats")
+
+    async def _mark_node_dead(self, node_id: str, reason: str) -> None:
+        info = self.nodes.get(node_id)
+        if info is None or not info["Alive"]:
+            return
+        info["Alive"] = False
+        self.available.pop(node_id, None)
+        if self._external:
+            self._external.remove_node(node_id)
+        # drop object locations on that node
+        for rec in self.objects.values():
+            rec["locations"].discard(node_id)
+        # fail over actors
+        for actor_id, rec in list(self.actors.items()):
+            if rec.get("node_id") == node_id and rec["state"] == "ALIVE":
+                await self._on_actor_failure(actor_id, f"node died: {reason}")
+        await self.rpc.publish("nodes", {"event": "dead", "node_id": node_id, "reason": reason})
+
+    # -------------------------------------------------------------------- kv
+    async def rpc_kv_put(self, key: str, value: bytes) -> bool:
+        self.kv[key] = value
+        return True
+
+    async def rpc_kv_get(self, key: str) -> Optional[bytes]:
+        return self.kv.get(key)
+
+    async def rpc_kv_del(self, key: str) -> bool:
+        return self.kv.pop(key, None) is not None
+
+    async def rpc_kv_keys(self, prefix: str = "") -> List[str]:
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    async def rpc_next_job_id(self) -> int:
+        self._job_counter += 1
+        return self._job_counter
+
+    # -------------------------------------------------------------- placement
+    def _feasible_nodes(self, resources: Dict[str, float],
+                        labels: Optional[Dict[str, str]] = None) -> List[str]:
+        out = []
+        for node_id, info in self.nodes.items():
+            if not info["Alive"]:
+                continue
+            if labels and any(info["Labels"].get(k) != v for k, v in labels.items()):
+                continue
+            total = info["Resources"]
+            if all(total.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()):
+                out.append(node_id)
+        return out
+
+    def _fits_now(self, node_id: str, resources: Dict[str, float]) -> bool:
+        avail = self.available.get(node_id, {})
+        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in resources.items())
+
+    async def rpc_schedule(
+        self,
+        requests: List[Dict[str, Any]],
+    ) -> List[Optional[str]]:
+        """Batched placement. Each request:
+        {resources, strategy: {kind, node_id?, soft?, labels?, pg?, bundle?}}
+        Returns a node_id hex (or None = infeasible right now) per request.
+        """
+        if self._external is not None:
+            return await self._external.schedule_batch(requests, self)
+        return [self._schedule_one(r) for r in requests]
+
+    def _schedule_one(self, req: Dict[str, Any]) -> Optional[str]:
+        resources = req.get("resources") or {}
+        strat = req.get("strategy") or {}
+        kind = strat.get("kind", "default")
+        if kind == "node_affinity":
+            node_id = strat.get("node_id", "")
+            if node_id in self.nodes and self.nodes[node_id]["Alive"]:
+                if self._fits_now(node_id, resources):
+                    return node_id
+                if not strat.get("soft"):
+                    return None
+            elif not strat.get("soft"):
+                return None
+        if kind == "placement_group":
+            pg = self.pgs.get(strat.get("pg", ""))
+            if pg is None:
+                return None
+            bundle = strat.get("bundle", -1)
+            indices = range(len(pg["bundles"])) if bundle < 0 else [bundle]
+            for i in indices:
+                node_id = pg["placement"][i]
+                need = pg["bundles"][i]
+                if all(need.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()) and \
+                        self.nodes.get(node_id, {}).get("Alive"):
+                    return node_id
+            return None
+        labels = strat.get("labels")
+        feasible = self._feasible_nodes(resources, labels)
+        if not feasible:
+            return None
+        fitting = [n for n in feasible if self._fits_now(n, resources)]
+        candidates = fitting or feasible
+        if kind == "spread":
+            self._spread_rr += 1
+            return candidates[self._spread_rr % len(candidates)]
+        # hybrid: pack onto busiest node below threshold utilization, else
+        # spread over top-k least-utilized (reference:
+        # hybrid_scheduling_policy.h pack-until-threshold + top-k random)
+        def utilization(n: str) -> float:
+            total = self.nodes[n]["Resources"]
+            avail = self.available.get(n, {})
+            u = 0.0
+            for k, tot in total.items():
+                if tot > 0:
+                    u = max(u, (tot - avail.get(k, tot)) / tot)
+            return u
+
+        below = [n for n in candidates if utilization(n) < config.scheduler_spread_threshold]
+        if below:
+            # pack: highest utilization first (fill nodes before opening new)
+            return max(below, key=utilization)
+        k = max(1, int(len(candidates) * config.scheduler_top_k_fraction))
+        top = sorted(candidates, key=utilization)[:k]
+        return random.choice(top)
+
+    # ------------------------------------------------------- placement groups
+    async def rpc_create_placement_group(
+        self, pg_id: str, bundles: List[Dict[str, float]], strategy: str, name: str
+    ) -> bool:
+        placement: List[Optional[str]] = [None] * len(bundles)
+        # Greedy 2-phase-lite: compute placement against current availability.
+        avail_copy = {n: dict(a) for n, a in self.available.items()
+                      if self.nodes.get(n, {}).get("Alive")}
+
+        def fits(node: str, need: Dict[str, float]) -> bool:
+            a = avail_copy.get(node, {})
+            return all(a.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+        def take(node: str, need: Dict[str, float]) -> None:
+            a = avail_copy[node]
+            for k, v in need.items():
+                a[k] = a.get(k, 0.0) - v
+
+        order = sorted(range(len(bundles)), key=lambda i: -sum(bundles[i].values()))
+        used_nodes: Set[str] = set()
+        for i in order:
+            need = bundles[i]
+            nodes = [n for n in avail_copy if fits(n, need)]
+            if strategy == "STRICT_SPREAD":
+                nodes = [n for n in nodes if n not in used_nodes]
+            elif strategy == "STRICT_PACK":
+                if used_nodes:
+                    nodes = [n for n in nodes if n in used_nodes]
+            elif strategy == "PACK":
+                packed = [n for n in nodes if n in used_nodes]
+                nodes = packed or nodes
+            elif strategy == "SPREAD":
+                fresh = [n for n in nodes if n not in used_nodes]
+                nodes = fresh or nodes
+            if not nodes:
+                return False
+            choice = nodes[0]
+            placement[i] = choice
+            used_nodes.add(choice)
+            take(choice, need)
+        # commit: deduct from the real availability view (agents also account
+        # locally when bundles are used; this reservation keeps the scheduler
+        # from overcommitting between heartbeats)
+        self.pgs[pg_id] = {
+            "bundles": [dict(b) for b in bundles],
+            "strategy": strategy,
+            "name": name,
+            "placement": placement,
+            "state": "CREATED",
+        }
+        return True
+
+    async def rpc_remove_placement_group(self, pg_id: str) -> bool:
+        return self.pgs.pop(pg_id, None) is not None
+
+    async def rpc_placement_group_info(self, pg_id: str) -> Optional[Dict[str, Any]]:
+        return self.pgs.get(pg_id)
+
+    async def rpc_placement_group_table(self) -> Dict[str, Dict[str, Any]]:
+        return dict(self.pgs)
+
+    # ----------------------------------------------------------------- actors
+    async def rpc_register_actor(
+        self,
+        actor_id: str,
+        class_name: str,
+        name: str = "",
+        namespace: str = "default",
+        max_restarts: int = 0,
+        spec: Optional[bytes] = None,
+    ) -> bool:
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors:
+                raise ValueError(f"Actor name '{name}' already taken in namespace '{namespace}'")
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "class_name": class_name,
+            "state": "PENDING",
+            "address": "",
+            "node_id": "",
+            "name": name,
+            "namespace": namespace,
+            "max_restarts": max_restarts,
+            "restarts": 0,
+            "spec": spec,
+            "death_reason": "",
+        }
+        return True
+
+    async def rpc_actor_started(self, actor_id: str, node_id: str, address: str) -> bool:
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return False
+        rec.update(state="ALIVE", node_id=node_id, address=address)
+        await self.rpc.publish("actors", {"event": "alive", "actor": _actor_public(rec)})
+        await self.rpc.publish(f"actor:{actor_id}", _actor_public(rec))
+        return True
+
+    async def rpc_actor_creation_failed(self, actor_id: str, reason: str) -> bool:
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return False
+        rec.update(state="DEAD", death_reason=reason)
+        self._drop_actor_name(actor_id)
+        await self.rpc.publish(f"actor:{actor_id}", _actor_public(rec))
+        return True
+
+    async def rpc_report_actor_death(self, actor_id: str, reason: str) -> bool:
+        await self._on_actor_failure(actor_id, reason)
+        return True
+
+    async def rpc_kill_actor(self, actor_id: str, no_restart: bool = True) -> bool:
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return False
+        if no_restart:
+            rec["max_restarts"] = 0
+        rec.update(state="DEAD", death_reason="killed")
+        self._drop_actor_name(actor_id)
+        await self.rpc.publish(f"actor:{actor_id}", _actor_public(rec))
+        await self.rpc.publish("actors", {"event": "dead", "actor": _actor_public(rec)})
+        return True
+
+    async def _on_actor_failure(self, actor_id: str, reason: str) -> None:
+        rec = self.actors.get(actor_id)
+        if rec is None or rec["state"] == "DEAD":
+            return
+        if rec["restarts"] < rec["max_restarts"]:
+            rec["restarts"] += 1
+            rec.update(state="RESTARTING", address="", node_id="")
+            await self.rpc.publish(f"actor:{actor_id}", _actor_public(rec))
+            await self.rpc.publish(
+                "actors", {"event": "restarting", "actor": _actor_public(rec)}
+            )
+        else:
+            rec.update(state="DEAD", death_reason=reason)
+            self._drop_actor_name(actor_id)
+            await self.rpc.publish(f"actor:{actor_id}", _actor_public(rec))
+            await self.rpc.publish("actors", {"event": "dead", "actor": _actor_public(rec)})
+
+    def _drop_actor_name(self, actor_id: str) -> None:
+        for key, aid in list(self.named_actors.items()):
+            if aid == actor_id:
+                del self.named_actors[key]
+
+    async def rpc_get_actor(self, actor_id: str) -> Optional[Dict[str, Any]]:
+        rec = self.actors.get(actor_id)
+        return _actor_public(rec) if rec else None
+
+    async def rpc_get_actor_spec(self, actor_id: str) -> Optional[bytes]:
+        rec = self.actors.get(actor_id)
+        return rec.get("spec") if rec else None
+
+    async def rpc_get_named_actor(self, name: str, namespace: str = "default") -> Optional[str]:
+        return self.named_actors.get((namespace, name))
+
+    async def rpc_list_named_actors(self, all_namespaces: bool = False,
+                                    namespace: str = "default") -> List[str]:
+        if all_namespaces:
+            return [n for (_ns, n) in self.named_actors]
+        return [n for (ns, n) in self.named_actors if ns == namespace]
+
+    async def rpc_list_actors(self) -> List[Dict[str, Any]]:
+        return [_actor_public(r) for r in self.actors.values()]
+
+    # ---------------------------------------------------------------- objects
+    async def rpc_register_object(
+        self, object_id: str, size: int, node_id: str, owner: str = ""
+    ) -> bool:
+        rec = self.objects.setdefault(
+            object_id, {"size": size, "locations": set(), "owner": owner}
+        )
+        rec["size"] = size
+        rec["locations"].add(node_id)
+        await self.rpc.publish(f"objects:{object_id}", {"size": size, "node_id": node_id})
+        return True
+
+    async def rpc_remove_object_location(self, object_id: str, node_id: str) -> bool:
+        rec = self.objects.get(object_id)
+        if rec:
+            rec["locations"].discard(node_id)
+        return True
+
+    async def rpc_lookup_object(self, object_id: str) -> Optional[Dict[str, Any]]:
+        rec = self.objects.get(object_id)
+        if rec is None:
+            return None
+        return {"size": rec["size"], "locations": sorted(rec["locations"]), "owner": rec["owner"]}
+
+    async def rpc_free_object(self, object_id: str) -> List[str]:
+        rec = self.objects.pop(object_id, None)
+        return sorted(rec["locations"]) if rec else []
+
+    # ------------------------------------------------------------------ debug
+    async def rpc_debug_state(self) -> Dict[str, Any]:
+        return {
+            "nodes": len([n for n in self.nodes.values() if n["Alive"]]),
+            "actors": len(self.actors),
+            "objects": len(self.objects),
+            "pgs": len(self.pgs),
+            "kv_keys": len(self.kv),
+            "uptime_s": time.time() - self._started_at,
+        }
+
+
+def _actor_public(rec: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in rec.items() if k != "spec"}
+
+
+def dict_config_snapshot() -> Dict[str, Any]:
+    return config.snapshot()
+
+
+async def serve_forever(host: str = "127.0.0.1", port: int = 0,
+                        ready_file: Optional[str] = None) -> None:
+    server = GcsServer(host, port)
+    h, p = await server.start()
+    if ready_file:
+        with open(ready_file, "w") as f:
+            f.write(f"{h}:{p}")
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="ray_tpu GCS server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--ready-file", default=None)
+    args = parser.parse_args()
+    asyncio.run(serve_forever(args.host, args.port, args.ready_file))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,309 @@
+"""Asyncio RPC layer: length-prefixed msgpack frames over TCP.
+
+Reference capability: src/ray/rpc/ (templated gRPC server/client with call
+manager, deadlines, retries) + rpc_chaos.{h,cc} fault injection. Design:
+
+- frame = [u32 little-endian length][msgpack map]
+- request:  {"i": id, "m": method, "p": params}
+- response: {"i": id, "r": result} | {"i": id, "e": [type, message]}
+- push:     {"c": channel, "d": data}   (server -> client pubsub)
+- chaos: ``config.rpc_chaos_failure_prob`` drops requests/responses randomly
+  (seeded) to exercise retry paths, like the reference's RpcFailure.
+
+Binary values pass through msgpack natively (use_bin_type). Handlers are
+``async def handler(**params) -> result``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import random
+import struct
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+from ray_tpu.core.config import config
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("rpc")
+
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    def __init__(self, remote_type: str, message: str):
+        self.remote_type = remote_type
+        super().__init__(f"{remote_type}: {message}")
+
+
+class RpcConnectionError(ConnectionError):
+    pass
+
+
+def _pack(obj: Any) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return struct.pack("<I", len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    (length,) = struct.unpack("<I", header)
+    if length > config.rpc_max_message_bytes:
+        raise ValueError(f"frame of {length} bytes exceeds limit")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+class _Chaos:
+    def __init__(self) -> None:
+        prob = config.rpc_chaos_failure_prob
+        self.prob = prob
+        self.rng = random.Random(config.rpc_chaos_seed or None) if prob > 0 else None
+
+    def should_drop(self) -> bool:
+        return self.rng is not None and self.rng.random() < self.prob
+
+
+class RpcServer:
+    """Serves handler coroutines; also supports pushing to subscribed clients."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: Dict[str, Callable[..., Awaitable[Any]]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        # channel -> set of writer
+        self._subscribers: Dict[str, set] = {}
+        # per-connection write locks: a slow/stalled subscriber must only
+        # block its own socket, never other connections' replies
+        self._writer_locks: Dict[asyncio.StreamWriter, asyncio.Lock] = {}
+        self._chaos = None
+
+    def handler(self, name: str):
+        def deco(fn):
+            self._handlers[name] = fn
+            return fn
+
+        return deco
+
+    def register(self, name: str, fn: Callable[..., Awaitable[Any]]) -> None:
+        self._handlers[name] = fn
+
+    def register_object(self, obj: Any, prefix: str = "") -> None:
+        """Every ``async def rpc_*`` method becomes a handler."""
+        for attr in dir(obj):
+            if attr.startswith("rpc_"):
+                self._handlers[prefix + attr[4:]] = getattr(obj, attr)
+
+    async def start(self) -> Tuple[str, int]:
+        self._chaos = _Chaos()
+        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        sock = self._server.sockets[0]
+        self.host, self.port = sock.getsockname()[:2]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._writer_locks[writer] = asyncio.Lock()
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                asyncio.ensure_future(self._dispatch(msg, writer))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:
+            logger.exception("rpc server: connection handler error")
+        finally:
+            for subs in self._subscribers.values():
+                subs.discard(writer)
+            self._writer_locks.pop(writer, None)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, msg: Dict, writer: asyncio.StreamWriter) -> None:
+        req_id = msg.get("i")
+        method = msg.get("m", "")
+        if self._chaos.should_drop():
+            logger.warning("rpc chaos: dropping request %s", method)
+            return
+        if method == "__subscribe__":
+            channel = msg["p"]["channel"]
+            self._subscribers.setdefault(channel, set()).add(writer)
+            await self._reply(writer, {"i": req_id, "r": True})
+            return
+        fn = self._handlers.get(method)
+        if fn is None:
+            await self._reply(writer, {"i": req_id, "e": ["KeyError", f"no handler {method!r}"]})
+            return
+        try:
+            result = await fn(**(msg.get("p") or {}))
+            resp = {"i": req_id, "r": result}
+        except Exception as e:  # noqa: BLE001 - serialize handler errors to caller
+            resp = {"i": req_id, "e": [type(e).__name__, str(e)]}
+        if self._chaos.should_drop():
+            logger.warning("rpc chaos: dropping response for %s", method)
+            return
+        await self._reply(writer, resp)
+
+    async def _reply(self, writer: asyncio.StreamWriter, obj: Any) -> None:
+        lock = self._writer_locks.get(writer)
+        if lock is None:
+            return
+        async with lock:
+            try:
+                writer.write(_pack(obj))
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def publish(self, channel: str, data: Any) -> None:
+        dead = []
+        frame = _pack({"c": channel, "d": data})
+        for w in list(self._subscribers.get(channel, set())):
+            lock = self._writer_locks.get(w)
+            if lock is None:
+                dead.append(w)
+                continue
+            async with lock:
+                try:
+                    # no drain(): a stalled subscriber buffers in its socket
+                    # instead of backpressuring the publisher
+                    w.write(frame)
+                except Exception:  # noqa: BLE001
+                    dead.append(w)
+        for w in dead:
+            self._subscribers.get(channel, set()).discard(w)
+
+
+class RpcClient:
+    """Async client with optional subscription callbacks."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._ids = itertools.count(1)
+        self._read_task: Optional[asyncio.Task] = None
+        self._sub_callbacks: Dict[str, Callable[[Any], None]] = {}
+        self._send_lock: Optional[asyncio.Lock] = None
+        self._closed = False
+
+    async def connect(self, timeout: Optional[float] = None) -> "RpcClient":
+        timeout = timeout or config.rpc_connect_timeout_s
+        deadline = asyncio.get_event_loop().time() + timeout
+        last_err: Optional[Exception] = None
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+                break
+            except OSError as e:
+                last_err = e
+                await asyncio.sleep(0.05)
+        else:
+            raise RpcConnectionError(f"cannot connect to {self.host}:{self.port}: {last_err}")
+        self._send_lock = asyncio.Lock()
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await _read_frame(self._reader)
+                if "c" in msg:  # pubsub push
+                    cb = self._sub_callbacks.get(msg["c"])
+                    if cb is not None:
+                        try:
+                            cb(msg["d"])
+                        except Exception:
+                            logger.exception("subscriber callback error")
+                    continue
+                fut = self._pending.pop(msg.get("i"), None)
+                if fut is None or fut.done():
+                    continue
+                if "e" in msg:
+                    fut.set_exception(RpcError(*msg["e"]))
+                else:
+                    fut.set_result(msg.get("r"))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(RpcConnectionError("connection lost"))
+            self._pending.clear()
+
+    async def call(self, method: str, timeout: Optional[float] = None, **params) -> Any:
+        if self._closed:
+            raise RpcConnectionError("client closed")
+        req_id = next(self._ids)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._send_lock:
+            self._writer.write(_pack({"i": req_id, "m": method, "p": params}))
+            await self._writer.drain()
+        timeout = timeout if timeout is not None else config.rpc_call_timeout_s
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise TimeoutError(f"rpc {method} timed out after {timeout}s") from None
+
+    async def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        self._sub_callbacks[channel] = callback
+        await self.call("__subscribe__", channel=channel)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._read_task is not None:
+            self._read_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+class SyncRpcClient:
+    """Thread-safe synchronous facade: owns a background event loop thread.
+    Used by driver/worker processes whose user code is synchronous."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True, name="rpc-client")
+        self._thread.start()
+        self._client = RpcClient(address)
+        self._run(self._client.connect())
+
+    def _run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    def call(self, method: str, timeout: Optional[float] = None, **params) -> Any:
+        return self._run(self._client.call(method, timeout=timeout, **params))
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        self._run(self._client.subscribe(channel, callback))
+
+    def close(self) -> None:
+        try:
+            self._run(self._client.close(), timeout=2)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)

@@ -1,0 +1,300 @@
+"""Shared-memory object store (plasma-equivalent, one segment per object).
+
+Reference capability: src/ray/object_manager/plasma/ — shared-memory
+immutable objects with zero-copy reads, eviction under pressure, and
+spill-to-disk. Differences by design:
+
+- one POSIX shm segment per object (kernel allocator) instead of a dlmalloc
+  arena: simpler, fragmentation-free; the C++ arena is a planned upgrade for
+  allocation-rate-bound workloads;
+- readers attach by name (derived from the ObjectID) and get zero-copy
+  memoryviews; ``serialization.unpack`` reconstructs numpy arrays aliasing
+  the segment;
+- the node agent owns the index (sizes, pins, LRU order) and enforces the
+  per-node budget with LRU eviction of unpinned sealed objects, spilling
+  them to ``<spill_dir>`` first when enabled (restore-on-get).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("shm_store")
+
+
+def segment_name(oid: ObjectID, node_suffix: str) -> str:
+    # FULL 48-hex id: a truncated prefix would collide for every put of the
+    # same task (ObjectID = TaskID ++ index, the index is at the END).
+    return f"rtpu-{node_suffix[:8]}-{oid.hex()}"
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """Detach the segment from this process's multiprocessing
+    resource_tracker. Python registers EVERY SharedMemory (even attaches)
+    and unlinks them when the registering process exits (bpo-38119) — which
+    would destroy sealed objects when a worker exits. Lifetime is owned by
+    the node agent's explicit delete/cleanup instead."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+class ShmWriter:
+    """Created by workers to write an object directly into shared memory."""
+
+    def __init__(self, oid: ObjectID, size: int, node_suffix: str):
+        self.oid = oid
+        self.size = size
+        self._shm = shared_memory.SharedMemory(
+            name=segment_name(oid, node_suffix), create=True, size=max(size, 1)
+        )
+        _untrack(self._shm)
+
+    @property
+    def buffer(self) -> memoryview:
+        return self._shm.buf[: self.size]
+
+    def seal(self) -> None:
+        self._shm.close()
+
+
+class ShmReader:
+    def __init__(self, oid: ObjectID, size: int, node_suffix: str):
+        self.oid = oid
+        self.size = size
+        self._shm = shared_memory.SharedMemory(name=segment_name(oid, node_suffix), create=False)
+        _untrack(self._shm)
+
+    @property
+    def buffer(self) -> memoryview:
+        return self._shm.buf[: self.size]
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except Exception:
+            pass
+
+
+@dataclass
+class _Entry:
+    size: int
+    sealed: bool = False
+    pinned: int = 0
+    spilled_path: Optional[str] = None
+    created_at: float = field(default_factory=time.time)
+
+
+class ShmObjectStore:
+    """Node-agent-side index + lifecycle manager for the shm segments."""
+
+    def __init__(self, node_suffix: str, capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self.node_suffix = node_suffix
+        self.capacity = capacity_bytes or config.object_store_memory_bytes
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._restore_lock = threading.Lock()
+        self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
+        self._used = 0
+
+    # ---- write path -------------------------------------------------------
+    def reserve(self, oid: ObjectID, size: int) -> None:
+        with self._lock:
+            if oid in self._entries:
+                raise FileExistsError(f"object {oid.hex()[:16]} already exists")
+            self._ensure_capacity(size)
+            self._entries[oid] = _Entry(size=size)
+            self._used += size
+
+    def seal(self, oid: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e.sealed = True
+                self._entries.move_to_end(oid)
+
+    def abort(self, oid: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.pop(oid, None)
+            if e is not None and e.spilled_path is None:
+                self._used -= e.size
+        self._unlink(oid)
+        if e is not None and e.spilled_path:
+            try:
+                os.unlink(e.spilled_path)
+            except OSError:
+                pass
+
+    # ---- read path --------------------------------------------------------
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(oid)
+            return e is not None and e.sealed
+
+    def info(self, oid: ObjectID) -> Optional[Tuple[int, bool]]:
+        with self._lock:
+            e = self._entries.get(oid)
+            return (e.size, e.sealed) if e else None
+
+    def touch(self, oid: ObjectID) -> None:
+        with self._lock:
+            if oid in self._entries:
+                self._entries.move_to_end(oid)
+
+    def ensure_local(self, oid: ObjectID) -> Optional[int]:
+        """Restore from spill if needed; returns size or None if unknown."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return None
+            if e.spilled_path is None:
+                self._entries.move_to_end(oid)
+                return e.size
+        return self._restore(oid)
+
+    # ---- lifecycle --------------------------------------------------------
+    def pin(self, oid: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e.pinned += 1
+
+    def unpin(self, oid: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None and e.pinned > 0:
+                e.pinned -= 1
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.pop(oid, None)
+            if e is None:
+                return
+            if e.spilled_path is None:
+                self._used -= e.size
+            spilled = e.spilled_path
+        self._unlink(oid)
+        if spilled:
+            try:
+                os.unlink(spilled)
+            except OSError:
+                pass
+
+    def usage(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "used": self._used,
+                "objects": len(self._entries),
+            }
+
+    # ---- internal ---------------------------------------------------------
+    def _ensure_capacity(self, size: int) -> None:
+        """Must hold lock. Evict (spill) LRU unpinned sealed objects."""
+        if size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds store capacity {self.capacity}"
+            )
+        spill_enabled = self.spill_dir is not None and config.object_spilling_enabled
+        attempts = 0
+        while self._used + size > self.capacity and attempts < config.object_store_full_retries:
+            victim = None
+            for oid, e in self._entries.items():
+                if e.sealed and e.pinned == 0 and e.spilled_path is None:
+                    victim = (oid, e)
+                    break
+            if victim is None:
+                break
+            void, ventry = victim
+            if spill_enabled:
+                self._spill_locked(void, ventry)
+            else:
+                self._entries.pop(void)
+                self._used -= ventry.size
+                self._unlink(void)
+            attempts += 1
+        if self._used + size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object store full: need {size}, used {self._used}/{self.capacity} "
+                f"and nothing evictable (all pinned or unsealed)"
+            )
+
+    def _spill_locked(self, oid: ObjectID, e: _Entry) -> None:
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, oid.hex())
+        try:
+            reader = ShmReader(oid, e.size, self.node_suffix)
+        except FileNotFoundError:
+            self._entries.pop(oid, None)
+            self._used -= e.size
+            return
+        try:
+            with open(path, "wb") as f:
+                f.write(reader.buffer)
+        finally:
+            reader.close()
+        self._unlink(oid)
+        e.spilled_path = path
+        self._used -= e.size
+        logger.debug("spilled %s (%d bytes)", oid.hex()[:16], e.size)
+
+    def _restore(self, oid: ObjectID) -> Optional[int]:
+        # _restore_lock serializes concurrent restores of the same (or any)
+        # spilled object; the re-check under _lock makes the loser a no-op
+        # instead of a FileExistsError on the segment create.
+        with self._restore_lock:
+            with self._lock:
+                e = self._entries.get(oid)
+                if e is None or e.spilled_path is None:
+                    return e.size if e else None
+                path = e.spilled_path
+                size = e.size
+                self._ensure_capacity(size)
+            data = open(path, "rb").read()
+            writer = ShmWriter(oid, len(data), self.node_suffix)
+            writer.buffer[:] = data
+            writer.seal()
+            with self._lock:
+                e = self._entries.get(oid)
+                if e is not None:
+                    e.spilled_path = None
+                    self._used += size
+                    self._entries.move_to_end(oid)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return size
+
+    def _unlink(self, oid: ObjectID) -> None:
+        try:
+            shm = shared_memory.SharedMemory(name=segment_name(oid, self.node_suffix))
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:  # noqa: BLE001
+            logger.debug("unlink failed for %s", oid.hex()[:16])
+
+    def cleanup(self) -> None:
+        with self._lock:
+            ids = list(self._entries)
+            self._entries.clear()
+            self._used = 0
+        for oid in ids:
+            self._unlink(oid)

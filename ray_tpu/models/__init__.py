@@ -1,0 +1,3 @@
+from ray_tpu.models.llama import LlamaConfig, llama_forward, llama_init, llama_logical_axes
+
+__all__ = ["LlamaConfig", "llama_forward", "llama_init", "llama_logical_axes"]

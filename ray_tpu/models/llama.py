@@ -1,0 +1,227 @@
+"""Llama-class decoder, TPU-first.
+
+Pure-functional JAX (param pytrees, no framework classes):
+
+- layers are STACKED along a leading axis and iterated with ``lax.scan`` —
+  one compiled layer body regardless of depth (fast compile, XLA-friendly);
+- every weight/activation carries logical axis names mapped to mesh axes by
+  ``parallel.sharding.ShardingRules`` (dp/fsdp/tp/sp/cp switchable without
+  touching the model);
+- attention uses ops.attention (Pallas flash on TPU);
+- rematerialization via ``jax.checkpoint`` on the layer body
+  (``remat="full" | "nothing_saveable" | None``);
+- bfloat16 activations/weights, fp32 RMSNorm statistics and logits.
+
+This is the flagship train/serve model named in BASELINE.json
+("Llama-3-8B ... no GPU in the loop"); the reference has no native model
+stack (it orchestrates torch), so this file cites capability, not code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, ShardingRules, shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: Optional[str] = "nothing_saveable"
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def num_params(self) -> int:
+        h, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        hd = self.head_dim_
+        attn = h * (self.num_heads * hd) * 2 + h * (self.num_kv_heads * hd) * 2
+        mlp = 3 * h * f
+        per_layer = attn + mlp + 2 * h
+        embed = v * h * (1 if self.tie_embeddings else 2)
+        return self.num_layers * per_layer + embed + h
+
+    # ---- preset family (sizes used by bench/tests) ----
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                   num_layers=32, num_heads=32, num_kv_heads=8, **kw)
+
+    @classmethod
+    def llama_1b(cls, **kw) -> "LlamaConfig":
+        return cls(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                   num_layers=22, num_heads=16, num_kv_heads=4, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        kw.setdefault("max_seq_len", 512)
+        kw.setdefault("rope_theta", 10000.0)
+        return cls(vocab_size=256, hidden_size=128, intermediate_size=256,
+                   num_layers=2, num_heads=4, num_kv_heads=2, **kw)
+
+
+def llama_logical_axes(config: LlamaConfig) -> Dict[str, Any]:
+    """Pytree of logical-axis tuples, parallel to the params pytree.
+    Leading 'layers' axis on stacked per-layer weights."""
+    axes = {
+        "embed_tokens": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def llama_init(config: LlamaConfig, key) -> Dict[str, Any]:
+    h = config.hidden_size
+    hd = config.head_dim_
+    nh, nkv = config.num_heads, config.num_kv_heads
+    f = config.intermediate_size
+    L = config.num_layers
+    dt = config.dtype
+
+    keys = jax.random.split(key, 8)
+
+    def normal(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)).astype(dt)
+
+    params = {
+        "embed_tokens": normal(keys[0], (config.vocab_size, h), h),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), dt),
+            "wq": normal(keys[1], (L, h, nh * hd), h),
+            "wk": normal(keys[2], (L, h, nkv * hd), h),
+            "wv": normal(keys[3], (L, h, nkv * hd), h),
+            "wo": normal(keys[4], (L, nh * hd, h), nh * hd),
+            "mlp_norm": jnp.ones((L, h), dt),
+            "w_gate": normal(keys[5], (L, h, f), h),
+            "w_up": normal(keys[6], (L, h, f), h),
+            "w_down": normal(keys[7], (L, f, h), f),
+        },
+        "final_norm": jnp.ones((h,), dt),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = normal(jax.random.fold_in(key, 99), (h, config.vocab_size), h)
+    return params
+
+
+def _layer(
+    config: LlamaConfig,
+    rules: ShardingRules,
+    mesh,
+    cos,
+    sin,
+    x,
+    lp: Dict[str, Any],
+):
+    """One decoder layer. x: [B, S, H]; lp: per-layer params (no leading L)."""
+    b, s, h = x.shape
+    nh, nkv, hd = config.num_heads, config.num_kv_heads, config.head_dim_
+
+    def cstr(t, axes):
+        if mesh is None:
+            return t
+        return shard_constraint(t, mesh, rules, axes)
+
+    # --- attention block ---
+    y = rms_norm(x, lp["attn_norm"], config.rms_eps)
+    q = (y @ lp["wq"]).reshape(b, s, nh, hd)
+    k = (y @ lp["wk"]).reshape(b, s, nkv, hd)
+    v = (y @ lp["wv"]).reshape(b, s, nkv, hd)
+    q = cstr(q, ("batch", "seq", "act_heads", "head_dim"))
+    k = cstr(k, ("batch", "seq", "act_kv_heads", "head_dim"))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    o = attention(q, k, v, causal=True, impl=config.attention_impl)
+    o = o.reshape(b, s, nh * hd)
+    x = x + cstr(o @ lp["wo"], ("batch", "seq", "act_embed"))
+
+    # --- mlp block (SwiGLU) ---
+    y = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+    gate = jax.nn.silu(y @ lp["w_gate"])
+    up = y @ lp["w_up"]
+    down = (gate * up) @ lp["w_down"]
+    x = x + cstr(down, ("batch", "seq", "act_embed"))
+    return x
+
+
+def llama_forward(
+    params: Dict[str, Any],
+    tokens,
+    config: LlamaConfig,
+    mesh=None,
+    rules: ShardingRules = DEFAULT_LLM_RULES,
+):
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+    b, s = tokens.shape
+    cos, sin = rope_frequencies(config.head_dim_, s, config.rope_theta)
+
+    x = params["embed_tokens"][tokens].astype(config.dtype)
+    if mesh is not None:
+        x = shard_constraint(x, mesh, rules, ("batch", "seq", "act_embed"))
+
+    layer_fn = functools.partial(_layer, config, rules, mesh, cos, sin)
+    if config.remat == "full":
+        layer_fn = jax.checkpoint(layer_fn)
+    elif config.remat == "nothing_saveable":
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    def scan_body(carry, lp):
+        return layer_fn(carry, lp), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T.astype(config.dtype)
+    logits = (x @ head).astype(jnp.float32)
+    if mesh is not None:
+        logits = shard_constraint(logits, mesh, rules, ("batch", "seq", "act_vocab"))
+    return logits
+
+
+def cross_entropy_loss(logits, targets, mask=None):
+    """logits: [B, S, V] fp32; targets: [B, S] int32."""
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is not None:
+        nll = nll * mask
+        return nll.sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()

@@ -1,0 +1,12 @@
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.ops.attention import attention, flash_attention, reference_attention
+
+__all__ = [
+    "apply_rope",
+    "attention",
+    "flash_attention",
+    "reference_attention",
+    "rms_norm",
+    "rope_frequencies",
+]

@@ -1,0 +1,229 @@
+"""Attention ops: Pallas TPU flash-attention forward + reference fallback.
+
+Design (see /opt/skills/guides/pallas_guide.md):
+- grid (batch, q_heads, q_blocks); K/V live whole-sequence in VMEM per
+  (batch, head) and the kernel streams over K blocks with the online-softmax
+  recurrence (running max m, normalizer l, fp32 accumulator) — the classic
+  flash pattern, so S×S scores never touch HBM.
+- causal masking skips fully-masked K blocks via the loop bound (block-level
+  skip), and applies an elementwise mask only on the diagonal block.
+- GQA: q heads map onto kv heads through the BlockSpec index_map
+  (h // q_per_kv), so kv tensors are never materialized per-q-head.
+- backward: custom_vjp recomputes with the jnp reference (correct, memory
+  O(S²) transient inside XLA); a Pallas backward kernel is the planned
+  upgrade.
+
+Replaces-the-capability-of: the reference's NCCL-attached attention stacks
+are external (DeepSpeed etc. via train integrations); here attention is a
+first-class framework op.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pragma: no cover - import guard for pallas-less builds
+    from jax.experimental import pallas as pl
+except Exception:  # noqa: BLE001
+    pl = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------------- #
+# Reference implementation (also the backward path)
+# --------------------------------------------------------------------------- #
+def reference_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """q: [B, Sq, Hq, D]; k/v: [B, Skv, Hkv, D]. Returns [B, Sq, Hq, D]."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if scale is None:
+        scale = d ** -0.5
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = jnp.arange(sq)[:, None] + (skv - sq)
+        kpos = jnp.arange(skv)[None, :]
+        mask = qpos >= kpos
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# --------------------------------------------------------------------------- #
+# Pallas forward kernel
+# --------------------------------------------------------------------------- #
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, seq_kv, causal, scale, offset):
+    # refs carry leading (1, 1) batch/head block dims:
+    # q_ref: [1, 1, block_q, D]; k_ref/v_ref: [1, 1, seq_kv, D]
+    # offset = seq_kv - seq_q: query row i sits at absolute position offset+i
+    # (the KV-cache decode case where cached keys precede the queries).
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    d = q.shape[-1]
+
+    q_start = qi * block_q + offset
+    if causal:
+        # number of k blocks any row of this q block can see
+        num_k_blocks = jax.lax.div(
+            jnp.minimum(q_start + block_q, seq_kv) + block_k - 1, block_k
+        )
+    else:
+        num_k_blocks = seq_kv // block_k
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
+    """q: [B, Sq, Hq, D] -> [B, Sq, Hq, D]. Requires Sq % block_q == 0 and
+    Skv % block_k == 0 (caller pads)."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    q_per_kv = hq // hkv
+    # layout for the kernel: [B, H, S, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (b, hq, sq // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        seq_kv=skv,
+        causal=causal,
+        scale=scale,
+        offset=skv - sq,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda bb, h, i, _g=q_per_kv: (bb, h // _g, 0, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda bb, h, i, _g=q_per_kv: (bb, h // _g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_attention_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: reference_attention(q_, k_, v_, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+):
+    """Flash attention with automatic padding to block multiples.
+
+    q: [B, Sq, Hq, D]; k/v: [B, Skv, Hkv, D] with Hq % Hkv == 0.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if hq % hkv:
+        raise ValueError(f"Hq={hq} must be a multiple of Hkv={hkv}")
+    block_q = min(block_q, _round_up(sq, 8))
+    block_k = min(block_k, _round_up(skv, 8))
+    if causal and skv < sq:
+        raise ValueError(f"causal attention requires Skv >= Sq, got {skv} < {sq}")
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    if pad_q or pad_k:
+        # Padding changes absolute positions (queries pad at the end, so the
+        # kernel's offset = skv-sq arithmetic shifts); with causal masking
+        # padded KV rows at the end are never attended by real queries only
+        # when the padded offset still places real queries before them —
+        # which holds exactly when both paddings grow the SAME amount. Fall
+        # back to the reference for ragged shapes outside that case.
+        if not causal or (sq + pad_q) - (skv + pad_k) != sq - skv:
+            return reference_attention(q, k, v, causal, scale)
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    out = _flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    if pad_q:
+        out = out[:, :sq]
+    return out
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def attention(q, k, v, causal: bool = True, scale: Optional[float] = None, impl: str = "auto"):
+    """Dispatch: pallas flash on TPU, reference elsewhere.
+
+    impl: "auto" | "flash" | "reference" | "flash_interpret"
+    """
+    if impl == "reference":
+        return reference_attention(q, k, v, causal, scale)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal, scale)
+    if impl == "flash_interpret":
+        return flash_attention(q, k, v, causal, scale, interpret=True)
+    on_tpu = any(d.platform == "tpu" for d in jax.devices()) and pl is not None
+    if on_tpu:
+        return flash_attention(q, k, v, causal, scale)
+    return reference_attention(q, k, v, causal, scale)

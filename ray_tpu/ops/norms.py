@@ -1,0 +1,19 @@
+"""Normalization ops.
+
+RMSNorm stays in jnp: XLA fuses the reduce + rsqrt + scale into the
+surrounding elementwise chain on TPU, so a Pallas kernel buys nothing here
+(HBM-bound either way); compute in fp32 for stability, cast back to the
+input dtype."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)

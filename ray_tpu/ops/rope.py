@@ -1,0 +1,31 @@
+"""Rotary position embeddings (RoPE), half-rotation layout."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0):
+    """Precompute cos/sin tables: [max_seq, head_dim//2] each (fp32)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin, positions=None):
+    """x: [..., seq, n_heads, head_dim]; cos/sin: [max_seq, head_dim//2];
+    positions: optional [..., seq] int32 (for decode with offsets)."""
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq]
+        s = sin[:seq]
+        # [seq, hd/2] -> [seq, 1, hd/2] to broadcast over heads
+        c = c[:, None, :]
+        s = s[:, None, :]
+    else:
+        c = cos[positions][..., None, :]
+        s = sin[positions][..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

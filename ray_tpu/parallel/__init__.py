@@ -1,0 +1,17 @@
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh, mesh_shape_for
+from ray_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_sharding,
+    shard_constraint,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshConfig",
+    "ShardingRules",
+    "logical_sharding",
+    "make_mesh",
+    "mesh_shape_for",
+    "shard_constraint",
+    "shard_pytree",
+]

@@ -1,0 +1,113 @@
+"""Logical-axis sharding rules (t5x/maxtext-style).
+
+Parameters and activations are annotated with *logical* axis names
+("embed", "mlp", "heads", "vocab", "batch", "seq", ...); a ``ShardingRules``
+table maps logical names to mesh axes. Changing the parallelism layout is a
+rules change, not a model change — the TPU-idiomatic analogue of the
+reference's per-backend process-group plumbing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    rules: Tuple[Tuple[str, MeshAxes], ...]
+
+    def lookup(self, logical_name: str) -> MeshAxes:
+        for name, axes in self.rules:
+            if name == logical_name:
+                return axes
+        return None
+
+    def spec(self, logical_axes: Sequence[Optional[str]]):
+        import jax
+
+        return jax.sharding.PartitionSpec(
+            *(self.lookup(a) if a is not None else None for a in logical_axes)
+        )
+
+    def with_overrides(self, **overrides: MeshAxes) -> "ShardingRules":
+        new = [(n, overrides.get(n, a)) for n, a in self.rules]
+        for n, a in overrides.items():
+            if not any(r[0] == n for r in self.rules):
+                new.append((n, a))
+        return ShardingRules(tuple(new))
+
+
+# Default LLM rules: FSDP shards the embed dim of every WEIGHT, TP shards
+# heads/mlp/vocab, CP shards sequence, batch over (dp, fsdp). Activations use
+# distinct logical names ("act_*"): their batch dim already consumes the fsdp
+# axis, so the activation embed dim must NOT also map to fsdp (a mesh axis may
+# appear at most once per spec). act_embed=None is the default; mapping it to
+# "tp" gives sequence-parallel style activation sharding between blocks.
+DEFAULT_LLM_RULES = ShardingRules(
+    rules=(
+        ("batch", ("dp", "fsdp")),
+        ("seq", "cp"),
+        ("embed", "fsdp"),
+        ("heads", "tp"),
+        ("kv_heads", "tp"),
+        ("head_dim", None),
+        ("mlp", "tp"),
+        ("vocab", "tp"),
+        ("layers", None),
+        ("expert", "ep"),
+        ("stage", "pp"),
+        # activation dims
+        ("act_embed", None),
+        ("act_heads", "tp"),
+        ("act_kv_heads", "tp"),
+        ("act_vocab", "tp"),
+    )
+)
+
+
+def logical_sharding(mesh, rules: ShardingRules, logical_axes: Sequence[Optional[str]]):
+    """NamedSharding for an array whose dims carry the given logical names."""
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, rules.spec(logical_axes))
+
+
+def shard_constraint(x, mesh, rules: ShardingRules, logical_axes: Sequence[Optional[str]]):
+    """with_sharding_constraint by logical names (inside jit)."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(x, logical_sharding(mesh, rules, logical_axes))
+
+
+def shard_pytree(tree: Any, axes_tree: Any, mesh, rules: ShardingRules):
+    """Device_put a pytree of arrays according to a parallel pytree of
+    logical-axis tuples."""
+    import jax
+
+    def place(x, axes):
+        return jax.device_put(x, logical_sharding(mesh, rules, axes))
+
+    return jax.tree.map(place, tree, axes_tree, is_leaf=lambda v: v is None)
+
+
+def sharding_pytree(axes_tree: Any, mesh, rules: ShardingRules):
+    """Pytree of NamedShardings from a pytree of logical-axis tuples (for jit
+    in_shardings/out_shardings)."""
+    return _map_axes(axes_tree, lambda axes: logical_sharding(mesh, rules, axes))
+
+
+def axes_is_leaf(v: Any) -> bool:
+    """True for logical-axes leaves: None, or a plain tuple of axis names.
+    NamedTuples (e.g. TrainState) are pytree nodes, not axes leaves."""
+    return v is None or (
+        type(v) is tuple and all(a is None or isinstance(a, str) for a in v)
+    )
+
+
+def _map_axes(axes_tree: Any, fn):
+    import jax
+
+    return jax.tree.map(fn, axes_tree, is_leaf=axes_is_leaf)

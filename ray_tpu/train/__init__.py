@@ -1,0 +1,3 @@
+from ray_tpu.train.step import TrainState, make_eval_step, make_train_state_factory, make_train_step
+
+__all__ = ["TrainState", "make_eval_step", "make_train_state_factory", "make_train_step"]

@@ -1,12 +1,21 @@
 import os
 
-# Configure JAX for a virtual 8-device CPU mesh BEFORE jax is imported
-# anywhere (the fake-TPU CI analogue: multi-chip logic runs on host devices).
+# Configure JAX for a virtual 8-device CPU mesh (the fake-TPU CI analogue:
+# multi-chip logic runs on host devices). jax may already be PRELOADED by the
+# environment (sitecustomize), so env vars alone are not reliable — use
+# jax.config, which works any time before backend initialization.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # backend already initialized (e.g. pytest re-entry); env vars got it
 
 import pytest
 

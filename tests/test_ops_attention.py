@@ -1,0 +1,133 @@
+"""Flash-attention kernel correctness vs the jnp reference.
+
+Run in pallas interpret mode on the CPU backend (the fake-TPU CI analogue);
+matmul precision is forced to HIGHEST because the backend's default matmul
+precision is bf16-like, which would swamp the comparison."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import flash_attention, reference_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+CASES = [
+    # (batch, seq, q_heads, kv_heads, head_dim, causal)
+    (2, 256, 4, 2, 64, True),
+    (1, 128, 8, 8, 32, True),
+    (2, 256, 4, 4, 64, False),
+    (1, 64, 2, 1, 128, True),
+    (1, 200, 2, 2, 64, True),  # non-multiple of block -> pad path
+]
+
+
+@pytest.mark.parametrize("b,sq,hq,hkv,d,causal", CASES)
+def test_flash_matches_reference(b, sq, hq, hkv, d, causal):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, sq, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, sq, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, sq, hkv, d)), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        ref = reference_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, interpret=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gradient_flows():
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+
+    with jax.default_matmul_precision("highest"):
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, interpret=True, block_q=64, block_k=64).sum()
+
+        def loss_ref(q, k, v):
+            return reference_attention(q, k, v).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5)
+
+
+def test_causal_masking_is_exact():
+    """Future tokens must have exactly zero influence."""
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    out1 = flash_attention(q, k, v, causal=True, interpret=True, block_q=64, block_k=64)
+    # perturb the second half of k/v; first half of outputs must be unchanged
+    k2 = k.at[:, 64:].add(100.0)
+    v2 = v.at[:, 64:].add(-50.0)
+    out2 = flash_attention(q, k2, v2, causal=True, interpret=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out1[:, :64]), np.asarray(out2[:, :64]), atol=1e-6)
+
+
+def test_rms_norm():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 16, 64)), jnp.float32)
+    w = jnp.ones((64,), jnp.float32) * 2.0
+    y = rms_norm(x, w)
+    expected = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6) * 2.0
+    np.testing.assert_allclose(np.asarray(y), expected, atol=1e-5, rtol=1e-5)
+
+
+def test_rope_properties():
+    cos, sin = rope_frequencies(64, 512)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((2, 16, 4, 64)), jnp.float32)
+    y = apply_rope(x, cos, sin)
+    # norm-preserving per (pos, head)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x[:, 0]), atol=1e-6)
+    # relative property: dot(q_m, k_n) depends only on m-n
+    q = jnp.asarray(rng.standard_normal((1, 8, 1, 64)), jnp.float32)
+    kk = jnp.asarray(np.tile(rng.standard_normal((1, 1, 1, 64)), (1, 8, 1, 1)), jnp.float32)
+    qq = jnp.asarray(np.tile(rng.standard_normal((1, 1, 1, 64)), (1, 8, 1, 1)), jnp.float32)
+    rq = np.asarray(apply_rope(qq, cos, sin))
+    rk = np.asarray(apply_rope(kk, cos, sin))
+    dots = [(rq[0, m, 0] * rk[0, m + 1, 0]).sum() for m in range(7)]
+    np.testing.assert_allclose(dots, dots[0] * np.ones(7), rtol=1e-4)
+
+
+def test_rope_with_positions():
+    cos, sin = rope_frequencies(32, 128)
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((1, 4, 2, 32)), jnp.float32)
+    pos = jnp.asarray([[10, 11, 12, 13]], jnp.int32)
+    y1 = apply_rope(x, cos, sin, positions=pos)
+    # same as embedding a length-14 sequence and slicing
+    xx = jnp.pad(x, ((0, 0), (10, 0), (0, 0), (0, 0)))
+    y2 = apply_rope(xx, cos, sin)[:, 10:]
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)
+
+
+def test_flash_kv_cache_decode_shape():
+    """sq != skv causal (cached prefix) — review regression: the kernel must
+    offset query positions by skv-sq, not silently mis-mask."""
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        ref = reference_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_causal_requires_kv_longer():
+    q = jnp.zeros((1, 128, 2, 32), jnp.float32)
+    k = jnp.zeros((1, 64, 2, 32), jnp.float32)
+    with pytest.raises(ValueError, match="Skv >= Sq"):
+        flash_attention(q, k, k, causal=True, interpret=True)
