@@ -1,0 +1,461 @@
+"""ClusterRuntime: CoreRuntime backend over a real multi-process cluster.
+
+Driver and worker processes both use this class; it speaks to:
+- the GCS (membership, actors, objects directory, KV, placement groups)
+- the LOCAL node agent (object plane, task submission)
+- actor workers DIRECTLY (per-call push, the agent is off the data path —
+  reference: transport/actor_task_submitter.h direct PushTask design).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import serialization
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.resources import (
+    DefaultSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+from ray_tpu.core.rpc import RpcError, SyncRpcClient
+from ray_tpu.core.runtime import CoreRuntime
+from ray_tpu.core.shm_store import ShmReader, ShmWriter, segment_name
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.core.worker import Worker, global_worker
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("cluster_runtime")
+
+
+def strategy_to_dict(strategy) -> Dict[str, Any]:
+    if isinstance(strategy, SpreadSchedulingStrategy):
+        return {"kind": "spread"}
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return {"kind": "node_affinity", "node_id": strategy.node_id, "soft": strategy.soft}
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        return {"kind": "default", "labels": dict(strategy.hard)}
+    if isinstance(strategy, PlacementGroupSchedulingStrategy) and strategy.placement_group is not None:
+        return {
+            "kind": "placement_group",
+            "pg": strategy.placement_group.id.hex(),
+            "bundle": strategy.placement_group_bundle_index,
+        }
+    return {"kind": "default"}
+
+
+class ClusterRuntime(CoreRuntime):
+    is_local = False
+
+    def __init__(
+        self,
+        gcs_address: str,
+        agent_address: str,
+        node_id: NodeID,
+        is_driver: bool = True,
+        namespace: str = "default",
+    ):
+        self.gcs_address = gcs_address
+        self.agent_address = agent_address
+        self.node_id = node_id
+        self.node_hex = node_id.hex()
+        self.namespace = namespace
+        self.gcs = SyncRpcClient(gcs_address)
+        self.agent = SyncRpcClient(agent_address)
+        self._exported_fns: set = set()
+        self._actor_clients: Dict[str, SyncRpcClient] = {}
+        self._actor_cache: Dict[str, Dict[str, Any]] = {}
+        self._dispatchers: Dict[str, Any] = {}
+        self._agent_clients: Dict[str, SyncRpcClient] = {agent_address: self.agent}
+        self._lock = threading.Lock()
+        self._bg = concurrent.futures.ThreadPoolExecutor(max_workers=16,
+                                                         thread_name_prefix="actor-call")
+
+    # ------------------------------------------------------------- objects
+    def put(self, value: Any) -> ObjectRef:
+        w = global_worker()
+        oid = w.next_put_id()
+        payload, _refs = serialization.pack(value)
+        self.agent.call("create_object", object_id=oid.hex(), size=len(payload))
+        writer = ShmWriter(oid, len(payload), self.node_hex)
+        writer.buffer[:] = payload
+        writer.seal()
+        self.agent.call("seal_object", object_id=oid.hex(), size=len(payload))
+        return ObjectRef(oid)
+
+    def _read_local(self, oid: ObjectID, size: int, is_error: bool) -> Any:
+        reader = ShmReader(oid, size, self.node_hex)
+        try:
+            value = serialization.unpack(bytes(reader.buffer), zero_copy=True)
+        finally:
+            reader.close()
+        if is_error:
+            err = value
+            if isinstance(err, exc.TaskError):
+                raise err.as_instanceof_cause()
+            raise err
+        return value
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        blocked = self._notify_blocked(True)
+        try:
+            for ref in refs:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                try:
+                    rpc_deadline = None if remaining is None else remaining + 5.0
+                    info = self.agent.call(
+                        "ensure_local", object_id=ref.id.hex(),
+                        timeout=rpc_deadline, timeout_s=remaining,
+                    )
+                except (TimeoutError, RpcError) as e:
+                    if isinstance(e, RpcError) and e.remote_type != "TimeoutError":
+                        raise
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {ref.id.hex()[:16]}"
+                    ) from None
+                out.append(self._read_local(ref.id, info["size"], info["is_error"]))
+        finally:
+            if blocked:
+                self._notify_blocked(False)
+        return out
+
+    def _notify_blocked(self, blocked: bool) -> bool:
+        """Within a worker: tell the agent this worker is blocked in get()
+        (its CPU lease is released while waiting). Driver: no-op."""
+        import os
+
+        worker_id = os.environ.get("RAY_TPU_WORKER_ID")
+        if worker_id is None:
+            return False
+        try:
+            self.agent.call(
+                "worker_blocked" if blocked else "worker_unblocked", worker_id=worker_id
+            )
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def wait(self, refs, num_returns, timeout, fetch_local):
+        ids = [r.id.hex() for r in refs]
+        ready_ids = self.agent.call(
+            "wait_objects", object_ids=ids, num_returns=num_returns,
+            timeout=None if timeout is None else timeout + 5.0,  # RPC deadline
+            timeout_s=timeout,
+        )
+        ready_set = set(ready_ids[:num_returns]) if len(ready_ids) > num_returns else set(ready_ids)
+        ready = [r for r in refs if r.id.hex() in ready_set]
+        not_ready = [r for r in refs if r.id.hex() not in ready_set]
+        return ready, not_ready
+
+    def free(self, refs: Sequence[ObjectRef]) -> None:
+        self.agent.call("free_objects", object_ids=[r.id.hex() for r in refs])
+
+    def release(self, oid: ObjectID) -> None:
+        # Cluster-wide auto-free on zero local refcount is deliberately OFF in
+        # this tier (no distributed borrow tracking yet); eviction is handled
+        # by the store's LRU+spill and explicit free().
+        pass
+
+    # --------------------------------------------------------------- tasks
+    def _export_function(self, function_id: str, fn: Any) -> None:
+        if function_id in self._exported_fns:
+            return
+        if self.gcs.call("kv_get", key=f"fn:{function_id}") is None:
+            self.gcs.call("kv_put", key=f"fn:{function_id}", value=cloudpickle.dumps(fn))
+        self._exported_fns.add(function_id)
+
+    def _spec_dict(self, spec: TaskSpec, args: tuple, kwargs: dict) -> Dict[str, Any]:
+        payload, _refs = serialization.pack((args, kwargs))
+        return {
+            "task_id": spec.task_id.binary().hex(),
+            "name": spec.name,
+            "function_id": spec.function.function_id,
+            "args_payload": payload,
+            "deps": [d.hex() for d in spec.dependencies()],
+            "returns": [r.hex() for r in spec.return_ids()],
+            "resources": dict(spec.resources),
+            "strategy": strategy_to_dict(spec.strategy),
+            "max_retries": spec.max_retries,
+            "retry_exceptions": spec.retry_exceptions,
+        }
+
+    def submit_task(self, spec: TaskSpec, func: Any, args: tuple, kwargs: dict) -> List[ObjectRef]:
+        self._export_function(spec.function.function_id, func)
+        sd = self._spec_dict(spec, args, kwargs)
+        self.agent.call("submit_task", spec=sd)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
+        logger.warning("cancel() is not yet supported on the cluster backend")
+
+    # -------------------------------------------------------------- actors
+    def create_actor(self, spec: TaskSpec, cls: Any, args: tuple, kwargs: dict) -> ActorID:
+        self._export_function(spec.function.function_id, cls)
+        name = (spec.runtime_env or {}).get("__actor_name__", "")
+        ns = (spec.runtime_env or {}).get("__actor_namespace__", self.namespace)
+        sd = self._spec_dict(spec, args, kwargs)
+        sd.update(
+            actor_id=spec.actor_id.hex(),
+            max_concurrency=spec.max_concurrency,
+            max_restarts=spec.max_restarts,
+        )
+        self._actor_cache[spec.actor_id.hex()] = {
+            "max_task_retries": spec.max_task_retries,
+            "max_concurrency": spec.max_concurrency,
+        }
+        # The GCS owns actor scheduling AND restart (GcsActorScheduler
+        # equivalent); one call registers + schedules.
+        self.gcs.call(
+            "create_actor",
+            spec=sd,
+            class_name=spec.name.split(".")[0],
+            name=name,
+            namespace=ns,
+            max_restarts=spec.max_restarts,
+            options=cloudpickle.dumps({
+                "options": {
+                    "max_task_retries": spec.max_task_retries,
+                    "max_concurrency": spec.max_concurrency,
+                },
+            }),
+        )
+        return spec.actor_id
+
+    def _resolve_actor(self, actor_hex: str, timeout: float = 60.0) -> Dict[str, Any]:
+        deadline = time.monotonic() + timeout
+        while True:
+            rec = self.gcs.call("get_actor", actor_id=actor_hex)
+            if rec is None:
+                raise exc.ActorDiedError(actor_hex, "unknown actor")
+            if rec["state"] == "ALIVE":
+                return rec
+            if rec["state"] == "DEAD":
+                raise exc.ActorDiedError(actor_hex, rec.get("death_reason") or "actor is dead")
+            if time.monotonic() > deadline:
+                raise exc.ActorUnavailableError(
+                    f"actor {actor_hex[:8]} still {rec['state']} after {timeout}s"
+                )
+            time.sleep(0.02)
+
+    def _actor_client(self, address: str) -> SyncRpcClient:
+        with self._lock:
+            client = self._actor_clients.get(address)
+            if client is None:
+                client = SyncRpcClient(address)
+                self._actor_clients[address] = client
+            return client
+
+    def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec, args, kwargs) -> List[ObjectRef]:
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        sd = self._spec_dict(spec, args, kwargs)
+        sd.update(actor_id=actor_id.hex(), method=spec.actor_method_name)
+        rec = self._actor_cache.get(actor_id.hex())
+        if rec is None:
+            rec = {}
+            raw = self.gcs.call("get_actor_spec", actor_id=actor_id.hex())
+            if raw:
+                try:
+                    rec = cloudpickle.loads(raw).get("options", {})
+                except Exception:  # noqa: BLE001
+                    rec = {}
+            self._actor_cache[actor_id.hex()] = rec
+        if rec.get("max_concurrency", 1) > 1:
+            # threaded/async actors: unordered concurrent pushes (reference
+            # semantics: ordering is only guaranteed for max_concurrency=1)
+            self._bg.submit(self._push_actor_task, actor_id.hex(), sd, spec.max_task_retries)
+        else:
+            # ordered: one dispatcher thread per actor preserves submission
+            # order end-to-end (ActorSchedulingQueue equivalent)
+            self._actor_dispatcher(actor_id.hex()).put((sd, spec.max_task_retries))
+        return refs
+
+    def _actor_dispatcher(self, actor_hex: str):
+        import queue as _q
+
+        with self._lock:
+            disp = self._dispatchers.get(actor_hex)
+            if disp is None:
+                disp = _q.Queue()
+                self._dispatchers[actor_hex] = disp
+
+                def loop() -> None:
+                    while True:
+                        item = disp.get()
+                        if item is None:
+                            return
+                        sd, retries = item
+                        try:
+                            self._push_actor_task(actor_hex, sd, retries)
+                        except Exception:  # noqa: BLE001
+                            logger.exception("actor dispatch failed")
+
+                threading.Thread(
+                    target=loop, daemon=True, name=f"actor-dispatch-{actor_hex[:8]}"
+                ).start()
+            return disp
+
+    def _push_actor_task(self, actor_hex: str, sd: Dict[str, Any], max_task_retries: int) -> None:
+        attempts = 0
+        while True:
+            try:
+                rec = self._resolve_actor(actor_hex)
+                client = self._actor_client(rec["address"])
+                client.call("run_actor_task", spec=sd, timeout=None)
+                return
+            except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
+                self._store_error_objects(sd, str(e), "ActorDiedError")
+                return
+            except (ConnectionError, RpcError, TimeoutError) as e:
+                # worker died mid-call or address stale
+                attempts += 1
+                if isinstance(e, RpcError) and e.remote_type not in (
+                    "ConnectionError", "RpcConnectionError", "ActorDiedError",
+                ):
+                    # handler-level error: results already stored as errors
+                    return
+                if attempts > max(max_task_retries, 0):
+                    self._store_error_objects(
+                        sd, f"actor call failed after {attempts} attempts: {e}",
+                        "ActorDiedError" if isinstance(e, RpcError) else "ActorUnavailableError",
+                    )
+                    return
+                time.sleep(0.1 * attempts)
+
+    def _store_error_objects(self, sd: Dict[str, Any], message: str, error_type: str) -> None:
+        try:
+            self.agent.call(
+                "store_error", returns=sd["returns"], name=sd.get("name", "?"),
+                message=message, error_type=error_type,
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to store error objects")
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        actor_hex = actor_id.hex()
+        rec = self.gcs.call("get_actor", actor_id=actor_hex)
+        self.gcs.call("kill_actor", actor_id=actor_hex, no_restart=no_restart)
+        if rec and rec.get("node_id"):
+            agent_addr = self._agent_addr_for(rec["node_id"])
+            if agent_addr:
+                try:
+                    self._agent_client(agent_addr).call("kill_actor_worker", actor_id=actor_hex)
+                except Exception:  # noqa: BLE001
+                    pass
+        self._actor_cache.pop(actor_hex, None)
+
+    def _agent_addr_for(self, node_hex: str) -> Optional[str]:
+        for info in self.gcs.call("get_nodes"):
+            if info["NodeID"] == node_hex:
+                return info["NodeManagerAddress"]
+        return None
+
+    def _agent_client(self, address: str) -> SyncRpcClient:
+        with self._lock:
+            client = self._agent_clients.get(address)
+            if client is None:
+                client = SyncRpcClient(address)
+                self._agent_clients[address] = client
+            return client
+
+    def get_named_actor(self, name: str, namespace: Optional[str]) -> ActorID:
+        actor_hex = self.gcs.call(
+            "get_named_actor", name=name, namespace=namespace or self.namespace
+        )
+        if actor_hex is None:
+            raise ValueError(f"Failed to look up actor '{name}'")
+        return ActorID.from_hex(actor_hex)
+
+    def list_named_actors(self, all_namespaces: bool = False, namespace: str = "default") -> List[str]:
+        return self.gcs.call(
+            "list_named_actors", all_namespaces=all_namespaces, namespace=namespace
+        )
+
+    # ------------------------------------------------------ placement groups
+    def create_placement_group(self, bundles, strategy: str, name: str) -> PlacementGroupID:
+        w = global_worker()
+        pg_id = PlacementGroupID.of(w.job_id)
+        ok = self.gcs.call(
+            "create_placement_group",
+            pg_id=pg_id.hex(), bundles=bundles, strategy=strategy, name=name,
+        )
+        if not ok:
+            raise exc.PlacementGroupError(
+                f"infeasible placement group ({strategy}, bundles={bundles})"
+            )
+        return pg_id
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        self.gcs.call("remove_placement_group", pg_id=pg_id.hex())
+
+    def placement_group_ready(self, pg_id: PlacementGroupID, timeout) -> bool:
+        return self.gcs.call("placement_group_info", pg_id=pg_id.hex()) is not None
+
+    def placement_group_table(self) -> Dict[str, Dict]:
+        return self.gcs.call("placement_group_table")
+
+    # --------------------------------------------------------------- cluster
+    def nodes(self) -> List[Dict[str, Any]]:
+        return self.gcs.call("get_nodes")
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.gcs.call("cluster_resources")
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.gcs.call("available_resources")
+
+    def shutdown(self) -> None:
+        for client in list(self._actor_clients.values()) + list(self._agent_clients.values()):
+            if client is not self.agent:
+                client.close()
+        self._bg.shutdown(wait=False)
+        self.agent.close()
+        self.gcs.close()
+
+    # -------------------------------------------------------------------- kv
+    def kv_put(self, key: str, value: bytes) -> None:
+        self.gcs.call("kv_put", key=key, value=value)
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.gcs.call("kv_get", key=key)
+
+    def kv_del(self, key: str) -> None:
+        self.gcs.call("kv_del", key=key)
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.gcs.call("kv_keys", prefix=prefix)
+
+
+def connect_driver(address: str, namespace: Optional[str] = None) -> Tuple[ClusterRuntime, Worker]:
+    """address = GCS host:port. The driver attaches to the head node's agent
+    (or the first alive node) as its local object/task plane."""
+    gcs = SyncRpcClient(address)
+    try:
+        nodes = [n for n in gcs.call("get_nodes") if n["Alive"]]
+        if not nodes:
+            raise RuntimeError(f"no alive nodes registered at GCS {address}")
+        head = next((n for n in nodes if n.get("is_head")), nodes[0])
+        job_n = gcs.call("next_job_id")
+    finally:
+        gcs.close()
+    runtime = ClusterRuntime(
+        gcs_address=address,
+        agent_address=head["NodeManagerAddress"],
+        node_id=NodeID.from_hex(head["NodeID"]),
+        is_driver=True,
+        namespace=namespace or "default",
+    )
+    worker = Worker(runtime, JobID.from_int(job_n), node_id=NodeID.from_hex(head["NodeID"]),
+                    is_driver=True)
+    return runtime, worker

@@ -333,15 +333,19 @@ class GcsServer:
         return dict(self.pgs)
 
     # ----------------------------------------------------------------- actors
-    async def rpc_register_actor(
+    async def rpc_create_actor(
         self,
-        actor_id: str,
-        class_name: str,
+        spec: Dict[str, Any],
+        class_name: str = "",
         name: str = "",
         namespace: str = "default",
         max_restarts: int = 0,
-        spec: Optional[bytes] = None,
+        options: Optional[bytes] = None,
     ) -> bool:
+        """Register AND schedule an actor. The GCS owns actor placement and
+        restart (reference: GcsActorManager + GcsActorScheduler,
+        gcs_actor_scheduler.cc:49 Schedule / restart on worker death)."""
+        actor_id = spec["actor_id"]
         if name:
             key = (namespace, name)
             if key in self.named_actors:
@@ -357,10 +361,116 @@ class GcsServer:
             "namespace": namespace,
             "max_restarts": max_restarts,
             "restarts": 0,
-            "spec": spec,
+            "spec": options,
+            "creation_spec": spec,
             "death_reason": "",
         }
+        asyncio.ensure_future(self._schedule_actor(actor_id))
         return True
+
+    async def _schedule_actor(self, actor_id: str) -> None:
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return
+        spec = rec["creation_spec"]
+        request = {"resources": spec.get("resources") or {},
+                   "strategy": spec.get("strategy") or {}}
+        backoff = 0.02
+        last_error = "unknown"
+        attempts = 0
+        while True:
+            rec = self.actors.get(actor_id)
+            if rec is None or rec["state"] == "DEAD":
+                return
+            target = self._schedule_one(request)
+            if target is None:
+                if not self._feasible_nodes(request["resources"]):
+                    # no alive node can EVER satisfy it right now; keep
+                    # waiting a bounded time for nodes to join, then fail
+                    attempts += 1
+                    if attempts > 200:
+                        await self._actor_creation_failed(
+                            actor_id, f"infeasible resources {request['resources']}"
+                        )
+                        return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 1.5, 1.0)
+                continue
+            client = await self._agent_client(target)
+            if client is None:
+                await asyncio.sleep(backoff)
+                continue
+            try:
+                result = await client.call("start_actor", spec=spec, timeout=None)
+            except Exception as e:  # noqa: BLE001 - node may die mid-start
+                last_error = str(e)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 1.5, 1.0)
+                continue
+            if result.get("ok"):
+                return  # agent reported actor_started
+            if not result.get("retryable", True):
+                await self._actor_creation_failed(
+                    actor_id, result.get("error", "constructor failed"), store=False
+                )
+                return
+            last_error = result.get("error", "start failed")
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 1.5, 1.0)
+
+    async def _actor_creation_failed(self, actor_id: str, reason: str, store: bool = True) -> None:
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return
+        rec.update(state="DEAD", death_reason=reason)
+        self._drop_actor_name(actor_id)
+        if store:
+            await self._store_error_objects(
+                rec["creation_spec"].get("returns", []),
+                rec["creation_spec"].get("name", "?"),
+                f"actor creation failed: {reason}",
+                "ActorDiedError",
+            )
+        await self.rpc.publish(f"actor:{actor_id}", _actor_public(rec))
+        await self.rpc.publish("actors", {"event": "dead", "actor": _actor_public(rec)})
+
+    async def _store_error_objects(self, returns: List[str], name: str,
+                                   message: str, error_type: str) -> None:
+        """Materialize error objects via any alive agent's store."""
+        for node_id, info in self.nodes.items():
+            if not info["Alive"]:
+                continue
+            client = await self._agent_client(node_id)
+            if client is None:
+                continue
+            try:
+                await client.call(
+                    "store_error", returns=returns, name=name,
+                    message=message, error_type=error_type,
+                )
+                return
+            except Exception:  # noqa: BLE001
+                continue
+        logger.error("no agent available to store error objects for %s", name)
+
+    async def _agent_client(self, node_id: str):
+        from ray_tpu.core.rpc import RpcClient
+
+        info = self.nodes.get(node_id)
+        if info is None or not info["Alive"]:
+            return None
+        client = getattr(self, "_agent_clients", None)
+        if client is None:
+            self._agent_clients = {}
+        cached = self._agent_clients.get(node_id)
+        if cached is not None and not cached._closed:
+            return cached
+        try:
+            c = await RpcClient(info["NodeManagerAddress"]).connect(timeout=2.0)
+        except Exception:  # noqa: BLE001
+            return None
+        self._agent_clients[node_id] = c
+        return c
 
     async def rpc_actor_started(self, actor_id: str, node_id: str, address: str) -> bool:
         rec = self.actors.get(actor_id)
@@ -372,12 +482,7 @@ class GcsServer:
         return True
 
     async def rpc_actor_creation_failed(self, actor_id: str, reason: str) -> bool:
-        rec = self.actors.get(actor_id)
-        if rec is None:
-            return False
-        rec.update(state="DEAD", death_reason=reason)
-        self._drop_actor_name(actor_id)
-        await self.rpc.publish(f"actor:{actor_id}", _actor_public(rec))
+        await self._actor_creation_failed(actor_id, reason, store=False)
         return True
 
     async def rpc_report_actor_death(self, actor_id: str, reason: str) -> bool:
@@ -407,6 +512,7 @@ class GcsServer:
             await self.rpc.publish(
                 "actors", {"event": "restarting", "actor": _actor_public(rec)}
             )
+            asyncio.ensure_future(self._schedule_actor(actor_id))
         else:
             rec.update(state="DEAD", death_reason=reason)
             self._drop_actor_name(actor_id)

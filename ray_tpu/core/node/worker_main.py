@@ -1,0 +1,288 @@
+"""Worker process: executes tasks and hosts actors.
+
+Reference capability: python/ray/_private/workers/default_worker.py +
+the CoreWorker execution path (task_receiver.h, _raylet.pyx
+task_execution_handler) — a process that registers with its node agent,
+serves direct task/actor-call RPCs (callers push work straight to the
+worker, the agent is off the per-call data path exactly like the
+reference's lease-then-PushTask design), executes user code on threads,
+and writes results into the node's shared-memory object plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import hashlib
+import os
+import queue
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import serialization
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.rpc import RpcClient, RpcServer, SyncRpcClient
+from ray_tpu.core.shm_store import ShmReader, ShmWriter
+from ray_tpu.utils.logging import get_logger, setup_component_logging
+
+logger = get_logger("worker")
+
+
+class WorkerProcess:
+    def __init__(self) -> None:
+        self.worker_id = os.environ["RAY_TPU_WORKER_ID"]
+        self.agent_addr = os.environ["RAY_TPU_AGENT_ADDR"]
+        self.gcs_addr = os.environ["RAY_TPU_GCS_ADDR"]
+        self.node_hex = os.environ["RAY_TPU_NODE_ID"]
+        self.rpc = RpcServer("127.0.0.1", 0)
+        self.rpc.register_object(self)
+        self.agent: Optional[RpcClient] = None
+        self._fn_cache: Dict[str, Any] = {}
+        self._exec_pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        # actor state
+        self.actor_id: Optional[str] = None
+        self.actor_instance: Any = None
+        self.actor_dead_error: Optional[BaseException] = None
+        self._actor_mailbox: "queue.Queue" = queue.Queue()
+        self._actor_thread: Optional[threading.Thread] = None
+        self._actor_max_concurrency = 1
+        self._actor_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        await self.rpc.start()
+        self.agent = await RpcClient(self.agent_addr).connect()
+        # worker-side runtime so user code can call the public API in-task
+        from ray_tpu.core.cluster_runtime import ClusterRuntime
+        from ray_tpu.core.worker import Worker, set_global_worker
+
+        runtime = ClusterRuntime(
+            gcs_address=self.gcs_addr, agent_address=self.agent_addr,
+            node_id=NodeID.from_hex(self.node_hex), is_driver=False,
+        )
+        worker = Worker(
+            runtime, JobID.from_int(1),
+            worker_id=WorkerID.from_hex(self.worker_id.ljust(32, "0")[:32]),
+            node_id=NodeID.from_hex(self.node_hex), is_driver=False,
+        )
+        worker.ref_counter.set_on_zero(lambda oid: None)  # workers don't own eviction
+        set_global_worker(worker)
+        self._worker_ctx = worker
+        await self.agent.call("worker_ready", worker_id=self.worker_id, address=self.rpc.address)
+        logger.info("worker %s ready at %s", self.worker_id[:8], self.rpc.address)
+
+    # ----------------------------------------------------------- helpers
+    def _load_function(self, function_id: str) -> Any:
+        fn = self._fn_cache.get(function_id)
+        if fn is None:
+            from ray_tpu.core.worker import global_worker
+
+            payload = global_worker().runtime.kv_get(f"fn:{function_id}")
+            if payload is None:
+                raise KeyError(f"function {function_id} not found in GCS KV")
+            fn = cloudpickle.loads(payload)
+            self._fn_cache[function_id] = fn
+        return fn
+
+    def _read_object(self, object_id: str, size: int) -> Any:
+        reader = ShmReader(ObjectID.from_hex(object_id), size, self.node_hex)
+        try:
+            # copy-then-unpack: the segment may be evicted once we release
+            return serialization.unpack(bytes(reader.buffer), zero_copy=True)
+        finally:
+            reader.close()
+
+    def _resolve_args(self, payload: bytes) -> tuple:
+        """Unpack (args, kwargs); resolve TOP-LEVEL ObjectRefs to values
+        (nested refs stay refs — reference semantics)."""
+        args, kwargs = serialization.unpack(memoryview(payload), zero_copy=False)
+        from ray_tpu import api
+
+        def resolve(v):
+            return api.get(v) if isinstance(v, ObjectRef) else v
+
+        return tuple(resolve(a) for a in args), {k: resolve(v) for k, v in kwargs.items()}
+
+    def _store_value(self, object_id: str, value: Any, is_error: bool = False) -> None:
+        payload, _refs = serialization.pack(value)
+        oid = ObjectID.from_hex(object_id)
+        fut = asyncio.run_coroutine_threadsafe(
+            self.agent.call("create_object", object_id=object_id, size=len(payload)),
+            self._loop,
+        )
+        fut.result()
+        writer = ShmWriter(oid, len(payload), self.node_hex)
+        writer.buffer[:] = payload
+        writer.seal()
+        asyncio.run_coroutine_threadsafe(
+            self.agent.call(
+                "seal_object", object_id=object_id, size=len(payload),
+                owner=":error" if is_error else "", is_error=is_error,
+            ),
+            self._loop,
+        ).result()
+
+    def _store_returns(self, spec: Dict[str, Any], result: Any) -> None:
+        returns: List[str] = spec["returns"]
+        if len(returns) == 1:
+            self._store_value(returns[0], result)
+            return
+        if not isinstance(result, (tuple, list)) or len(result) != len(returns):
+            err = exc.TaskError(
+                spec.get("name", "?"),
+                f"declared num_returns={len(returns)} but returned "
+                f"{type(result).__name__}",
+            )
+            for r in returns:
+                self._store_value(r, err, is_error=True)
+            return
+        for r, v in zip(returns, result):
+            self._store_value(r, v)
+
+    def _store_error_returns(self, spec: Dict[str, Any], e: BaseException) -> None:
+        err = exc.TaskError.from_exception(
+            e, spec.get("name", "?"), pid=os.getpid(), node_id=self.node_hex
+        )
+        for r in spec["returns"]:
+            try:
+                self._store_value(r, err, is_error=True)
+            except FileExistsError:
+                pass
+
+    # ------------------------------------------------------------- task rpc
+    async def rpc_run_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        return await self._loop.run_in_executor(self._exec_pool, self._execute_task, spec)
+
+    def _execute_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.core.worker import global_worker
+
+        w = global_worker()
+        task_id = TaskID(bytes.fromhex(spec["task_id"]))
+        attempts = 0
+        max_attempts = 1 + (spec.get("max_retries", 0) if spec.get("retry_exceptions") else 0)
+        while True:
+            w.set_task_context(task_id, None, spec.get("name", ""), attempt=attempts)
+            try:
+                fn = self._load_function(spec["function_id"])
+                args, kwargs = self._resolve_args(spec["args_payload"])
+                result = fn(*args, **kwargs)
+                self._store_returns(spec, result)
+                return {"state": "ok"}
+            except BaseException as e:  # noqa: BLE001
+                attempts += 1
+                if attempts < max_attempts:
+                    continue
+                self._store_error_returns(spec, e)
+                return {"state": "error"}
+            finally:
+                w.set_task_context(None)
+
+    # ------------------------------------------------------------ actor rpc
+    async def rpc_start_actor(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        if self.actor_id is not None and self.actor_id != spec["actor_id"]:
+            return {"ok": False, "retryable": True,
+                    "error": f"worker already hosts actor {self.actor_id[:8]}"}
+        self.actor_id = spec["actor_id"]
+        self._actor_max_concurrency = max(1, int(spec.get("max_concurrency", 1)))
+        result = await self._loop.run_in_executor(None, self._construct_actor, spec)
+        return result
+
+    def _construct_actor(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.core.worker import global_worker
+
+        w = global_worker()
+        task_id = TaskID(bytes.fromhex(spec["task_id"]))
+        w.set_task_context(task_id, ActorID.from_hex(spec["actor_id"]), spec.get("name", ""))
+        try:
+            cls = self._load_function(spec["function_id"])
+            args, kwargs = self._resolve_args(spec["args_payload"])
+            self.actor_instance = cls(*args, **kwargs)
+            try:
+                self._store_value(spec["returns"][0], None)
+            except Exception:  # noqa: BLE001 - restart: marker already stored
+                pass
+            if self._actor_max_concurrency > 1:
+                self._actor_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self._actor_max_concurrency
+                )
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            self.actor_dead_error = e
+            self._store_error_returns(spec, e)
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        finally:
+            w.set_task_context(None)
+
+    async def rpc_run_actor_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        if self.actor_instance is None:
+            raise exc.ActorDiedError(self.actor_id or "", "actor not constructed")
+        if spec.get("actor_id") != self.actor_id:
+            # stale routing: this worker hosts a different actor
+            raise ConnectionError(
+                f"worker hosts actor {str(self.actor_id)[:8]}, not {spec.get('actor_id', '')[:8]}"
+            )
+        pool = self._actor_pool
+        if pool is not None:
+            return await self._loop.run_in_executor(pool, self._execute_actor_task, spec)
+        # max_concurrency == 1: dedicated ordered executor (single thread)
+        return await self._loop.run_in_executor(self._ordered_executor(), self._execute_actor_task, spec)
+
+    _ordered: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+    def _ordered_executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        if self._ordered is None:
+            self._ordered = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        return self._ordered
+
+    def _execute_actor_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.core.worker import global_worker
+
+        w = global_worker()
+        task_id = TaskID(bytes.fromhex(spec["task_id"]))
+        w.set_task_context(
+            task_id, ActorID.from_hex(spec["actor_id"]), spec.get("name", "")
+        )
+        try:
+            method = getattr(self.actor_instance, spec["method"])
+            args, kwargs = self._resolve_args(spec["args_payload"])
+            result = method(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = asyncio.run_coroutine_threadsafe(result, self._loop).result()
+            self._store_returns(spec, result)
+            return {"state": "ok"}
+        except BaseException as e:  # noqa: BLE001
+            self._store_error_returns(spec, e)
+            if isinstance(e, (SystemExit, KeyboardInterrupt)):
+                os._exit(1)
+            return {"state": "error"}
+        finally:
+            w.set_task_context(None)
+
+    async def rpc_terminate(self) -> bool:
+        asyncio.get_event_loop().call_later(0.05, os._exit, 0)
+        return True
+
+    async def rpc_ping(self) -> str:
+        return "pong"
+
+
+def main() -> None:
+    setup_component_logging("worker", os.environ.get("RAY_TPU_SESSION_DIR"), also_stderr=True)
+
+    async def run() -> None:
+        wp = WorkerProcess()
+        await wp.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,10 @@ logger = get_logger("rpc")
 
 MAX_FRAME = 1 << 31
 
+# Sentinel: "use the configured default deadline". Pass timeout=None for an
+# INFINITE deadline (long-running task pushes, blocking gets).
+DEFAULT_TIMEOUT = object()
+
 
 class RpcError(Exception):
     def __init__(self, remote_type: str, message: str):
@@ -248,7 +252,7 @@ class RpcClient:
                     fut.set_exception(RpcConnectionError("connection lost"))
             self._pending.clear()
 
-    async def call(self, method: str, timeout: Optional[float] = None, **params) -> Any:
+    async def call(self, method: str, timeout: Any = DEFAULT_TIMEOUT, **params) -> Any:
         if self._closed:
             raise RpcConnectionError("client closed")
         req_id = next(self._ids)
@@ -257,8 +261,11 @@ class RpcClient:
         async with self._send_lock:
             self._writer.write(_pack({"i": req_id, "m": method, "p": params}))
             await self._writer.drain()
-        timeout = timeout if timeout is not None else config.rpc_call_timeout_s
+        if timeout is DEFAULT_TIMEOUT:
+            timeout = config.rpc_call_timeout_s
         try:
+            if timeout is None:
+                return await fut  # infinite deadline (connection loss still errors)
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             self._pending.pop(req_id, None)
@@ -295,7 +302,7 @@ class SyncRpcClient:
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         return fut.result(timeout)
 
-    def call(self, method: str, timeout: Optional[float] = None, **params) -> Any:
+    def call(self, method: str, timeout: Any = DEFAULT_TIMEOUT, **params) -> Any:
         return self._run(self._client.call(method, timeout=timeout, **params))
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:

@@ -1,0 +1,114 @@
+"""Blocks: the unit of data movement (reference: python/ray/data/block.py —
+Block = arrow table / pandas df; BlockAccessor for format-generic ops).
+
+Canonical in-memory format is a pyarrow.Table; batches surface as
+dict-of-numpy ("numpy", the TPU-friendly default), arrow, or pandas.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+Batch = Union[Dict[str, np.ndarray], pa.Table, "pd.DataFrame"]  # noqa: F821
+
+
+def _normalize_column(values: Any) -> pa.Array:
+    if isinstance(values, pa.Array):
+        return values
+    arr = np.asarray(values)
+    if arr.ndim > 1:
+        # tensor column: fixed-size lists
+        flat = arr.reshape(len(arr), -1)
+        return pa.FixedSizeListArray.from_arrays(
+            pa.array(flat.ravel()), flat.shape[1]
+        )
+    return pa.array(arr)
+
+
+def block_from_batch(batch: Batch) -> Block:
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        return pa.table({k: _normalize_column(v) for k, v in batch.items()})
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    raise TypeError(f"cannot convert {type(batch).__name__} to a block")
+
+
+def block_from_rows(rows: List[Any]) -> Block:
+    if rows and isinstance(rows[0], dict):
+        cols: Dict[str, list] = {}
+        for r in rows:
+            for k, v in r.items():
+                cols.setdefault(k, []).append(v)
+        return pa.table({k: _normalize_column(v) for k, v in cols.items()})
+    return pa.table({"item": _normalize_column(rows)})
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self.block = block
+
+    @classmethod
+    def for_block(cls, block: Block) -> "BlockAccessor":
+        return cls(block)
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self.block.schema
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    def to_arrow(self) -> pa.Table:
+        return self.block
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for name in self.block.column_names:
+            col = self.block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                combined = col.combine_chunks()
+                if isinstance(combined, pa.ChunkedArray):
+                    combined = combined.chunk(0)
+                values = combined.values.to_numpy(zero_copy_only=False)
+                out[name] = values.reshape(len(col), -1)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        return self.block.to_pandas()
+
+    def to_batch(self, batch_format: str) -> Batch:
+        if batch_format in ("numpy", "default"):
+            return self.to_numpy()
+        if batch_format in ("pyarrow", "arrow"):
+            return self.block
+        if batch_format == "pandas":
+            return self.to_pandas()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for i in range(self.block.num_rows):
+            yield {name: self.block.column(name)[i].as_py() for name in self.block.column_names}
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks)

@@ -1,0 +1,375 @@
+"""Dataset: lazy, streaming-executed distributed data.
+
+Reference capability: python/ray/data/dataset.py (+ read_api.py,
+iterator.py): lazy logical plan built by transformations, executed by the
+streaming executor on iteration/consumption; per-worker shards via
+streaming_split; device-prefetching batch iteration for TPU input pipelines
+(the host→HBM double-buffering tier the reference leaves to torch loaders).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.data.block import Batch, Block, BlockAccessor, block_from_batch, block_from_rows, concat_blocks
+from ray_tpu.data.executor import (
+    DEFAULT_MAX_IN_FLIGHT,
+    MapStage,
+    RepartitionStage,
+    ShuffleStage,
+    Stage,
+    StreamingExecutor,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("data")
+
+
+class Dataset:
+    def __init__(self, source_fn: Callable[[], Iterator[ObjectRef]], stages: Optional[List[Stage]] = None):
+        self._source_fn = source_fn
+        self._stages: List[Stage] = stages or []
+
+    # ------------------------------------------------------------ transforms
+    def _with_stage(self, stage: Stage) -> "Dataset":
+        return Dataset(self._source_fn, self._stages + [stage])
+
+    def map_batches(
+        self,
+        fn: Union[Callable[[Batch], Batch], type],
+        *,
+        batch_format: str = "numpy",
+        batch_size: Optional[int] = None,
+        num_cpus: float = 1.0,
+        concurrency: Optional[int] = None,
+        fn_constructor_args: tuple = (),
+        **_ignored,
+    ) -> "Dataset":
+        if isinstance(fn, type):
+            cls = fn
+
+            def ctor():
+                return cls(*fn_constructor_args)
+
+            def block_fn(block: Block, callable_obj) -> Block:
+                batch = BlockAccessor(block).to_batch(batch_format)
+                return block_from_batch(callable_obj(batch))
+
+            return self._with_stage(
+                MapStage(f"map_batches({cls.__name__})", block_fn,
+                         num_cpus=num_cpus, fn_constructor=ctor, concurrency=concurrency)
+            )
+
+        def block_fn(block: Block) -> Block:
+            batch = BlockAccessor(block).to_batch(batch_format)
+            return block_from_batch(fn(batch))
+
+        return self._with_stage(
+            MapStage(f"map_batches({getattr(fn, '__name__', 'fn')})", block_fn, num_cpus=num_cpus)
+        )
+
+    def map(self, fn: Callable[[Dict], Dict], num_cpus: float = 1.0) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
+            return block_from_rows(rows)
+
+        return self._with_stage(MapStage(f"map({getattr(fn, '__name__', 'fn')})", block_fn, num_cpus=num_cpus))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]], num_cpus: float = 1.0) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            rows: List[Dict] = []
+            for r in BlockAccessor(block).iter_rows():
+                rows.extend(fn(r))
+            return block_from_rows(rows)
+
+        return self._with_stage(MapStage("flat_map", block_fn, num_cpus=num_cpus))
+
+    def filter(self, fn: Callable[[Dict], bool], num_cpus: float = 1.0) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            import pyarrow as pa
+
+            mask = pa.array([fn(r) for r in BlockAccessor(block).iter_rows()])
+            return block.filter(mask)
+
+        return self._with_stage(MapStage("filter", block_fn, num_cpus=num_cpus))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_stage(RepartitionStage(num_blocks))
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        return self._with_stage(ShuffleStage(seed))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        selves = [self, *others]
+
+        def source() -> Iterator[ObjectRef]:
+            for ds in selves:
+                yield from ds._execute()
+
+        return Dataset(source)
+
+    def limit(self, n: int) -> "Dataset":
+        parent = self
+
+        def source() -> Iterator[ObjectRef]:
+            remaining = n
+            for ref in parent._execute():
+                if remaining <= 0:
+                    return
+                block = ray_tpu.get(ref)
+                rows = block.num_rows
+                if rows <= remaining:
+                    remaining -= rows
+                    yield ref
+                else:
+                    yield ray_tpu.put(BlockAccessor(block).slice(0, remaining))
+                    remaining = 0
+
+        return Dataset(source)
+
+    # ----------------------------------------------------------- consumption
+    def _execute(self) -> Iterator[ObjectRef]:
+        return StreamingExecutor(self._stages).execute(self._source_fn())
+
+    def iter_internal_refs(self) -> Iterator[ObjectRef]:
+        return self._execute()
+
+    def take(self, limit: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for ref in self._execute():
+            for row in BlockAccessor(ray_tpu.get(ref)).iter_rows():
+                out.append(row)
+                if len(out) >= limit:
+                    return out
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return [r for ref in self._execute() for r in BlockAccessor(ray_tpu.get(ref)).iter_rows()]
+
+    def count(self) -> int:
+        return sum(ray_tpu.get(ref).num_rows for ref in self._execute())
+
+    def schema(self):
+        for ref in self._execute():
+            return ray_tpu.get(ref).schema
+        return None
+
+    def materialize(self) -> "Dataset":
+        refs = list(self._execute())
+
+        def source() -> Iterator[ObjectRef]:
+            return iter(refs)
+
+        return Dataset(source)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._execute():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        prefetch_batches: int = 2,
+        drop_last: bool = False,
+    ) -> Iterator[Batch]:
+        return _batch_iterator(self._execute(), batch_size, batch_format,
+                               prefetch_batches, drop_last)
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        prefetch_batches: int = 2,
+        drop_last: bool = True,
+        sharding=None,
+        dtype=None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Device-side prefetch: batches are transferred to HBM ahead of
+        consumption (double-buffering, config.device_prefetch_depth)."""
+        import jax
+
+        from ray_tpu.core.config import config
+
+        host_iter = self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            prefetch_batches=prefetch_batches, drop_last=drop_last,
+        )
+
+        def to_device(batch: Dict[str, np.ndarray]):
+            out = {}
+            for k, v in batch.items():
+                arr = v if dtype is None else v.astype(dtype)
+                out[k] = jax.device_put(arr, sharding) if sharding is not None else jax.device_put(arr)
+            return out
+
+        depth = max(1, config.device_prefetch_depth)
+        buf: "_queue.deque" = __import__("collections").deque()
+        for batch in host_iter:
+            buf.append(to_device(batch))
+            if len(buf) >= depth:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> List["DataIterator"]:
+        """Split into n per-consumer iterators fed round-robin from one
+        execution (reference: dataset.py:1363 streaming_split used by Train's
+        DataConfig for per-worker shards). Each shard is backed by a queue
+        ACTOR so the iterator handle is serializable into train workers."""
+        # max_concurrency>1: a consumer blocked in get() must not starve puts
+        shards = [_ShardQueue.options(max_concurrency=4).remote() for _ in range(n)]
+        parent = self
+
+        def feeder() -> None:
+            try:
+                for i, ref in enumerate(parent._execute()):
+                    # put the BLOCK (values serialize; refs are per-process
+                    # futures only in local mode)
+                    ray_tpu.get(shards[i % n].put.remote(ray_tpu.get(ref)))
+            finally:
+                for s in shards:
+                    s.close.remote()
+
+        threading.Thread(target=feeder, daemon=True, name="streaming-split").start()
+        return [DataIterator(s) for s in shards]
+
+    # ---------------------------------------------------------------- output
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            pq.write_table(ray_tpu.get(ref), f"{path}/part-{i:05d}.parquet")
+
+    def write_json(self, path: str) -> None:
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            with open(f"{path}/part-{i:05d}.jsonl", "w") as f:
+                for row in BlockAccessor(ray_tpu.get(ref)).iter_rows():
+                    f.write(json.dumps(row, default=str) + "\n")
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        import pyarrow.csv as pacsv
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            pacsv.write_csv(ray_tpu.get(ref), f"{path}/part-{i:05d}.csv")
+
+    def stats(self) -> str:
+        return f"Dataset(stages={[s.name for s in self._stages]})"
+
+    def __repr__(self) -> str:
+        return f"Dataset(num_stages={len(self._stages)})"
+
+
+@ray_tpu.remote
+class _ShardQueue:
+    """Bounded block queue between one execution and one consumer; the actor
+    handle serializes into train workers (async: puts and gets interleave)."""
+
+    def __init__(self, maxsize: int = 8):
+        import asyncio
+
+        self._q = None
+        self._maxsize = maxsize
+
+    def _queue(self):
+        import asyncio
+
+        if self._q is None:
+            self._q = asyncio.Queue(maxsize=self._maxsize)
+        return self._q
+
+    async def put(self, block) -> bool:
+        await self._queue().put(block)
+        return True
+
+    async def close(self) -> bool:
+        await self._queue().put(None)
+        return True
+
+    async def get(self):
+        return await self._queue().get()
+
+
+class DataIterator:
+    """Per-consumer shard handle (reference: data/iterator.py DataIterator).
+    Serializable: backed by a _ShardQueue actor."""
+
+    def __init__(self, shard_actor: Any):
+        self._shard = shard_actor
+
+    def __reduce__(self):
+        return (DataIterator, (self._shard,))
+
+    def _refs(self) -> Iterator[ObjectRef]:
+        while True:
+            block = ray_tpu.get(self._shard.get.remote())
+            if block is None:
+                return
+            yield ray_tpu.put(block)
+
+    def iter_batches(self, *, batch_size: int = 256, batch_format: str = "numpy",
+                     prefetch_batches: int = 2, drop_last: bool = False) -> Iterator[Batch]:
+        return _batch_iterator(self._refs(), batch_size, batch_format,
+                               prefetch_batches, drop_last)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._refs():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+
+def _batch_iterator(refs: Iterator[ObjectRef], batch_size: int, batch_format: str,
+                    prefetch_batches: int, drop_last: bool) -> Iterator[Batch]:
+    """Re-chunk a stream of blocks into fixed-size batches with background
+    block prefetch (reference: _internal/block_batching)."""
+    out_q: "_queue.Queue" = _queue.Queue(maxsize=max(1, prefetch_batches))
+    DONE = object()
+
+    def producer() -> None:
+        try:
+            carry: Optional[Block] = None
+            for ref in refs:
+                block = ray_tpu.get(ref)
+                if carry is not None:
+                    block = concat_blocks([carry, block])
+                    carry = None
+                offset = 0
+                n = block.num_rows
+                while n - offset >= batch_size:
+                    out_q.put(BlockAccessor(block).slice(offset, offset + batch_size))
+                    offset += batch_size
+                if offset < n:
+                    carry = BlockAccessor(block).slice(offset, n)
+            if carry is not None and carry.num_rows and not drop_last:
+                out_q.put(carry)
+        except BaseException as e:  # noqa: BLE001
+            out_q.put(e)
+            return
+        finally:
+            out_q.put(DONE)
+
+    threading.Thread(target=producer, daemon=True, name="batch-prefetch").start()
+    while True:
+        item = out_q.get()
+        if item is DONE:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield BlockAccessor(item).to_batch(batch_format)

@@ -1,0 +1,207 @@
+"""Data sources (reference: python/ray/data/read_api.py — 19 read_* entry
+points; the core family implemented here, each producing read tasks that
+execute in parallel on the cluster)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.data.block import block_from_batch, block_from_rows
+from ray_tpu.data.dataset import Dataset
+
+
+def _parallel_read(make_tasks: List[Callable[[], Any]], name: str) -> Dataset:
+    """Each thunk becomes a remote read task producing one block."""
+
+    import builtins
+
+    def source() -> Iterator[ObjectRef]:
+        @ray_tpu.remote(num_cpus=1, name=f"data::read_{name}")
+        def read_one(idx: int):
+            return make_tasks[idx]()
+
+        from ray_tpu.data.executor import DEFAULT_MAX_IN_FLIGHT, _iter_completed
+
+        def submitted():
+            # builtins.range: this module defines its own `range` dataset API
+            for i in builtins.range(len(make_tasks)):
+                yield read_one.remote(i)
+
+        yield from _iter_completed(submitted(), DEFAULT_MAX_IN_FLIGHT)
+
+    return Dataset(source)
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    import builtins
+
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+
+    def make(lo: int, hi: int):
+        return lambda: block_from_batch({"id": np.arange(lo, hi, dtype=np.int64)})
+
+    tasks = [make(i * per, min((i + 1) * per, n))
+             for i in builtins.range(parallelism) if i * per < n]
+    return _parallel_read(tasks, "range")
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = 8) -> Dataset:
+    import builtins
+
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+
+    def make(lo: int, hi: int):
+        def thunk():
+            count = hi - lo
+            data = np.broadcast_to(
+                np.arange(lo, hi, dtype=np.int64).reshape((count,) + (1,) * len(shape)),
+                (count,) + shape,
+            ).copy()
+            return block_from_batch({"data": data})
+
+        return thunk
+
+    tasks = [make(i * per, min((i + 1) * per, n))
+             for i in builtins.range(parallelism) if i * per < n]
+    return _parallel_read(tasks, "range_tensor")
+
+
+def from_items(items: List[Any], *, parallelism: int = 4) -> Dataset:
+    import builtins
+
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    per = (len(items) + parallelism - 1) // parallelism
+    chunks = [items[i * per : (i + 1) * per] for i in builtins.range(parallelism)]
+    chunks = [c for c in chunks if c]
+
+    def make(chunk):
+        return lambda: block_from_rows(chunk)
+
+    return _parallel_read([make(c) for c in chunks], "items")
+
+
+def from_numpy(arrays: Dict[str, np.ndarray]) -> Dataset:
+    def thunk():
+        return block_from_batch(arrays)
+
+    return _parallel_read([thunk], "numpy")
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    def thunk():
+        return pa.Table.from_pandas(df, preserve_index=False)
+
+    return _parallel_read([thunk], "pandas")
+
+
+def from_arrow(table) -> Dataset:
+    return _parallel_read([lambda: table], "arrow")
+
+
+def _expand_paths(paths, suffixes: tuple) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files += [os.path.join(root, f) for f in sorted(names)
+                          if f.endswith(suffixes)]
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no files found under {paths}")
+    return files
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, (".parquet",))
+
+    def make(f):
+        def thunk():
+            import pyarrow.parquet as pq
+
+            return pq.read_table(f)
+
+        return thunk
+
+    return _parallel_read([make(f) for f in files], "parquet")
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, (".csv",))
+
+    def make(f):
+        def thunk():
+            import pyarrow.csv as pacsv
+
+            return pacsv.read_csv(f)
+
+        return thunk
+
+    return _parallel_read([make(f) for f in files], "csv")
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, (".json", ".jsonl"))
+
+    def make(f):
+        def thunk():
+            import pyarrow.json as pajson
+
+            return pajson.read_json(f)
+
+        return thunk
+
+    return _parallel_read([make(f) for f in files], "json")
+
+
+def read_text(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, (".txt",))
+
+    def make(f):
+        def thunk():
+            with open(f) as fh:
+                return block_from_batch({"text": np.asarray(fh.read().splitlines(), dtype=object)})
+
+        return thunk
+
+    return _parallel_read([make(f) for f in files], "text")
+
+
+def read_numpy(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, (".npy", ".npz"))
+
+    def make(f):
+        def thunk():
+            arr = np.load(f, allow_pickle=False)
+            if hasattr(arr, "files"):  # npz
+                return block_from_batch({k: arr[k] for k in arr.files})
+            return block_from_batch({"data": arr})
+
+        return thunk
+
+    return _parallel_read([make(f) for f in files], "numpy")
+
+
+def read_binary_files(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ())
+
+    def make(f):
+        def thunk():
+            with open(f, "rb") as fh:
+                data = fh.read()
+            return block_from_rows([{"path": f, "bytes": data}])
+
+        return thunk
+
+    return _parallel_read([make(f) for f in files], "binary")

@@ -1,0 +1,125 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+The reference has no native MoE/expert-parallel support (SURVEY §2.4);
+here it is a framework op, GSPMD-idiomatic: the experts dimension carries
+the logical axis "expert" (→ ep); with sharding constraints in place XLA
+inserts the dispatch/combine all-to-alls over ICI — no manual NCCL-style
+a2a plumbing.
+
+Capacity-based top-k routing (Switch/Mixtral style): tokens beyond an
+expert's capacity are dropped (contribute zero), keeping shapes static for
+XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import ShardingRules, shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_jitter: float = 0.0
+
+
+def moe_init(key, config: MoeConfig, hidden: int, ffn: int, dtype=jnp.bfloat16) -> Dict[str, Any]:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    E = config.num_experts
+
+    def normal(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * fan_in**-0.5).astype(dtype)
+
+    return {
+        "router": normal(k1, (hidden, E), hidden).astype(jnp.float32),
+        "w_gate": normal(k2, (E, hidden, ffn), hidden),
+        "w_up": normal(k3, (E, hidden, ffn), hidden),
+        "w_down": normal(k4, (E, ffn, hidden), ffn),
+    }
+
+
+def moe_logical_axes() -> Dict[str, Tuple]:
+    return {
+        "router": ("embed", None),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def moe_apply(
+    params: Dict[str, Any],
+    x: jax.Array,  # [B, S, D]
+    config: MoeConfig,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Returns (output [B,S,D], aux metrics incl. load-balance loss)."""
+    b, s, d = x.shape
+    E, K = config.num_experts, config.top_k
+    n_tokens = b * s
+    capacity = max(1, int(n_tokens * K / E * config.capacity_factor))
+
+    xf = x.reshape(n_tokens, d)
+    logits = xf.astype(jnp.float32) @ params["router"]  # [N, E]
+    if config.router_jitter and rng is not None:
+        logits = logits + jax.random.uniform(
+            rng, logits.shape, minval=-config.router_jitter, maxval=config.router_jitter
+        )
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [N, K]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, k) in its expert's buffer; beyond capacity -> drop
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [N, K, E]
+    # sequential positions per expert over flattened (N*K) choices
+    flat = onehot.reshape(n_tokens * K, E)
+    positions = jnp.cumsum(flat, axis=0) - flat  # [N*K, E]
+    pos_in_expert = (positions * flat).sum(-1).reshape(n_tokens, K)
+    keep = pos_in_expert < capacity
+
+    # dispatch tensor: [N, K] -> buffers [E, C, D]
+    token_ids = jnp.arange(n_tokens)[:, None].repeat(K, 1)
+    dispatch = jnp.zeros((E, capacity, d), x.dtype)
+    dispatch = dispatch.at[
+        gate_idx.reshape(-1), jnp.where(keep, pos_in_expert, capacity - 1).reshape(-1)
+    ].add(
+        jnp.where(keep.reshape(-1, 1), xf[token_ids.reshape(-1)], 0).astype(x.dtype)
+    )
+
+    if mesh is not None and rules is not None:
+        dispatch = shard_constraint(dispatch, mesh, rules, ("expert", None, None))
+
+    # expert FFN (SwiGLU), batched over E: [E, C, D] x [E, D, F]
+    gate_act = jax.nn.silu(jnp.einsum("ecd,edf->ecf", dispatch, params["w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", dispatch, params["w_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gate_act * up, params["w_down"])
+    if mesh is not None and rules is not None:
+        expert_out = shard_constraint(expert_out, mesh, rules, ("expert", None, None))
+
+    # combine back: token t gets sum_k gate_k * expert_out[e_k, pos_k]
+    gathered = expert_out[
+        gate_idx.reshape(-1), jnp.clip(pos_in_expert, 0, capacity - 1).reshape(-1)
+    ].reshape(n_tokens, K, d)
+    combined = (gathered.astype(jnp.float32)
+                * (gate_vals * keep).astype(jnp.float32)[..., None]).sum(1)
+
+    # load-balance aux loss (Switch): E * sum_e f_e * P_e
+    denom = jnp.maximum(jnp.sum(keep), 1).astype(jnp.float32)
+    f = (onehot * keep[..., None]).sum((0, 1)).astype(jnp.float32) / denom
+    p_mean = probs.mean(0)
+    aux_loss = E * jnp.sum(f * p_mean)
+    dropped = 1.0 - denom / (n_tokens * K)
+
+    return combined.reshape(b, s, d).astype(x.dtype), {
+        "moe_aux_loss": aux_loss,
+        "moe_dropped_fraction": dropped,
+    }
