@@ -275,6 +275,72 @@ class GcsServer:
     async def rpc_create_placement_group(
         self, pg_id: str, bundles: List[Dict[str, float]], strategy: str, name: str
     ) -> bool:
+        """Two-phase gang reservation (reference: GcsPlacementGroupScheduler
+        prepare/commit): compute a placement, then COMMIT each bundle on its
+        agent — the agent deducts from its availability so heartbeats report
+        the reduced capacity and unrelated work can't consume the gang's
+        resources. Retries the whole placement if a commit races."""
+        for _ in range(3):
+            placement = self._plan_placement(bundles, strategy)
+            if placement is None:
+                return False
+            committed: List[int] = []
+            ok = True
+            refused_node: Optional[str] = None
+            for i, node_id in enumerate(placement):
+                client = await self._agent_client(node_id)
+                granted = False
+                if client is not None:
+                    try:
+                        granted = await client.call(
+                            "reserve_bundle", pg_id=pg_id, bundle_index=i,
+                            resources=bundles[i],
+                        )
+                    except Exception:  # noqa: BLE001 - node may die mid-commit
+                        granted = False
+                if not granted:
+                    ok = False
+                    refused_node = node_id
+                    # the RPC may have landed on the agent even though the
+                    # reply was lost: roll this index back too (return_bundle
+                    # is a no-op if the commit never happened)
+                    committed.append(i)
+                    break
+                committed.append(i)
+            if ok:
+                self.pgs[pg_id] = {
+                    "bundles": [dict(b) for b in bundles],
+                    "strategy": strategy,
+                    "name": name,
+                    "placement": placement,
+                    "state": "CREATED",
+                }
+                return True
+            # roll back partial commits and retry against fresh availability
+            for i in committed:
+                client = await self._agent_client(placement[i])
+                if client is not None:
+                    try:
+                        await client.call("return_bundle", pg_id=pg_id, bundle_index=i)
+                    except Exception:  # noqa: BLE001
+                        pass
+            # heartbeats only refresh self.available every ~1s — far slower
+            # than this retry loop. Pull the refusing node's live availability
+            # directly so the replan doesn't re-pick the identical placement.
+            if refused_node is not None:
+                client = await self._agent_client(refused_node)
+                if client is not None:
+                    try:
+                        info = await client.call("node_info")
+                        self.available[refused_node] = dict(info["available"])
+                    except Exception:  # noqa: BLE001
+                        pass
+            await asyncio.sleep(0.02)
+        return False
+
+    def _plan_placement(
+        self, bundles: List[Dict[str, float]], strategy: str
+    ) -> Optional[List[str]]:
         placement: List[Optional[str]] = [None] * len(bundles)
         # Greedy 2-phase-lite: compute placement against current availability.
         avail_copy = {n: dict(a) for n, a in self.available.items()
@@ -306,25 +372,25 @@ class GcsServer:
                 fresh = [n for n in nodes if n not in used_nodes]
                 nodes = fresh or nodes
             if not nodes:
-                return False
+                return None
             choice = nodes[0]
             placement[i] = choice
             used_nodes.add(choice)
             take(choice, need)
-        # commit: deduct from the real availability view (agents also account
-        # locally when bundles are used; this reservation keeps the scheduler
-        # from overcommitting between heartbeats)
-        self.pgs[pg_id] = {
-            "bundles": [dict(b) for b in bundles],
-            "strategy": strategy,
-            "name": name,
-            "placement": placement,
-            "state": "CREATED",
-        }
-        return True
+        return placement
 
     async def rpc_remove_placement_group(self, pg_id: str) -> bool:
-        return self.pgs.pop(pg_id, None) is not None
+        pg = self.pgs.pop(pg_id, None)
+        if pg is None:
+            return False
+        for node_id in set(pg["placement"]):
+            client = await self._agent_client(node_id)
+            if client is not None:
+                try:
+                    await client.call("return_bundle", pg_id=pg_id, bundle_index=-1)
+                except Exception:  # noqa: BLE001
+                    pass
+        return True
 
     async def rpc_placement_group_info(self, pg_id: str) -> Optional[Dict[str, Any]]:
         return self.pgs.get(pg_id)

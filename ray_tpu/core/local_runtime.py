@@ -617,15 +617,20 @@ class LocalRuntime(CoreRuntime):
         if task is None:
             return
         task.cancelled = True
+        # Lock order everywhere else is _pending_lock -> task.lock
+        # (_drain_pending); never nest _pending_lock inside task.lock here or
+        # a concurrent cancel + dispatch can deadlock the whole runtime.
         with task.lock:
-            if not task.dispatched:
+            claimed = not task.dispatched
+            if claimed:
                 task.dispatched = True
-                err = exc.TaskCancelledError(task.spec.task_id.hex())
-                for oid in task.spec.return_ids():
-                    self._store.seal(oid, error=err)
-                with self._pending_lock:
-                    if task in self._pending:
-                        self._pending.remove(task)
+        if claimed:
+            err = exc.TaskCancelledError(task.spec.task_id.hex())
+            for oid in task.spec.return_ids():
+                self._store.seal(oid, error=err)
+            with self._pending_lock:
+                if task in self._pending:
+                    self._pending.remove(task)
 
     # ------------------------------------------------------------------ actors
     def create_actor(self, spec: TaskSpec, cls: type, args: tuple, kwargs: dict) -> ActorID:

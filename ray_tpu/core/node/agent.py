@@ -46,7 +46,8 @@ class _WorkerHandle:
         self.state = "STARTING"  # STARTING | IDLE | LEASED | ACTOR | DEAD
         self.actor_id: Optional[str] = None
         self.ready = asyncio.Event()
-        self.lease_resources: Optional[Dict[str, float]] = None
+        self.lease_token: Optional[Tuple[str, Any, Dict[str, float]]] = None
+        self._actor_token: Optional[Tuple[str, Any, Dict[str, float]]] = None
         self.blocked = False
 
 
@@ -96,6 +97,12 @@ class NodeAgent:
         self._pull_locks: Dict[str, asyncio.Lock] = {}
         self._max_workers = max(1, int(ncpus))
         self._shutting_down = False
+        # committed placement-group bundle reservations living on THIS node:
+        # (pg_id, bundle_index) -> {"total": resources, "avail": remaining}.
+        # Reserved out of self.available at prepare time so heartbeats report
+        # the reduced capacity and unrelated tasks can't consume a gang's
+        # resources (reference: raylet prepared/committed bundle state).
+        self._pg_bundles: Dict[Tuple[str, int], Dict[str, Dict[str, float]]] = {}
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> Tuple[str, int]:
@@ -168,10 +175,10 @@ class NodeAgent:
         if w in self._idle_workers:
             self._idle_workers.remove(w)
         logger.warning("worker %s died (state=%s)", w.worker_id[:8], prev_state)
-        res = getattr(w, "_actor_resources", None)
-        if res:
-            self._release_resources(res)
-            w._actor_resources = None
+        token = w._actor_token
+        if token is not None:
+            self._release_token(token)
+            w._actor_token = None
         if w.actor_id is not None:
             try:
                 await self.gcs.call(
@@ -314,6 +321,29 @@ class NodeAgent:
                     raise TimeoutError(f"object {object_id[:16]} not available")
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 0.5)
+
+    async def rpc_ensure_local_batch(
+        self, object_ids: List[str], timeout_s: Optional[float] = None
+    ) -> List[Dict[str, Any]]:
+        """Batched ensure_local: all pulls run concurrently on the agent's
+        loop (reference: plasma batched Get + parallel PullManager pulls).
+        Per-object failures come back in-band as {"error", "error_type"} so
+        one missing object doesn't poison the whole batch."""
+        results = await asyncio.gather(
+            *[self.rpc_ensure_local(o, timeout_s=timeout_s) for o in object_ids],
+            return_exceptions=True,
+        )
+        out: List[Dict[str, Any]] = []
+        for object_id, res in zip(object_ids, results):
+            if isinstance(res, BaseException):
+                out.append({
+                    "error": str(res) or type(res).__name__,
+                    "error_type": type(res).__name__,
+                    "object_id": object_id,
+                })
+            else:
+                out.append(res)
+        return out
 
     async def _pull(self, oid: ObjectID, size: int, locations: List[str]) -> bool:
         """Chunked pull from a peer agent (reference: PullManager/PushManager
@@ -517,17 +547,17 @@ class NodeAgent:
                 await self.rpc_ensure_local(dep, timeout_s=config.worker_lease_timeout_s * 10)
         except TimeoutError as e:
             return {"ok": False, "retryable": True, "reason": "busy", "error": f"deps unavailable: {e}"}
-        # 2. resources
-        resources = spec.get("resources") or {}
-        if not self._try_acquire(resources):
+        # 2. resources (PG tasks draw from their committed bundle)
+        token = self._acquire_for_spec(spec)
+        if token is None:
             return {"ok": False, "retryable": True, "reason": "busy", "error": "resources busy"}
         # 3. worker lease + push
         try:
             w = await self._lease_worker()
         except TimeoutError as e:
-            self._release_resources(resources)
+            self._release_token(token)
             return {"ok": False, "retryable": True, "reason": "busy", "error": str(e)}
-        w.lease_resources = resources
+        w.lease_token = token
         try:
             result = await w.client.call("run_task", spec=spec, timeout=None)
             return {"ok": True, **(result or {})}
@@ -538,10 +568,10 @@ class NodeAgent:
             return {"ok": False, "retryable": True, "error": f"worker connection lost: {e}"}
         finally:
             if not w.blocked:
-                self._release_resources(resources)
+                self._release_token(token)
             else:
                 w.blocked = False  # resources already released at block time
-            w.lease_resources = None
+            w.lease_token = None
             self._release_worker(w)
 
     def _try_acquire(self, resources: Dict[str, float]) -> bool:
@@ -555,6 +585,75 @@ class NodeAgent:
     def _release_resources(self, resources: Dict[str, float]) -> None:
         for k, v in resources.items():
             self.available[k] = self.available.get(k, 0.0) + v
+
+    # -------------------------------------------------- placement-group bundles
+    async def rpc_reserve_bundle(
+        self, pg_id: str, bundle_index: int, resources: Dict[str, float]
+    ) -> bool:
+        key = (pg_id, bundle_index)
+        if key in self._pg_bundles:
+            return True  # idempotent re-commit
+        if not self._try_acquire(resources):
+            return False
+        self._pg_bundles[key] = {"total": dict(resources), "avail": dict(resources)}
+        return True
+
+    async def rpc_return_bundle(self, pg_id: str, bundle_index: int = -1) -> bool:
+        """Release bundle reservation(s) back to node availability.
+        bundle_index < 0 releases every bundle of the pg on this node.
+        In-flight tasks still drawing from a returned bundle release into a
+        no-op (the full bundle already went back) — PG removal while tasks
+        run is destructive, matching the reference."""
+        for key in list(self._pg_bundles):
+            if key[0] == pg_id and (bundle_index < 0 or key[1] == bundle_index):
+                rec = self._pg_bundles.pop(key)
+                self._release_resources(rec["total"])
+        return True
+
+    def _acquire_for_spec(self, spec: Dict[str, Any]) -> Optional[Tuple[str, Any, Dict[str, float]]]:
+        """Acquire execution resources for a task/actor spec. PG-scheduled
+        work draws from its committed bundle; everything else from the node
+        pool. Returns an opaque token for _release_token, or None if busy."""
+        resources = spec.get("resources") or {}
+        strat = spec.get("strategy") or {}
+        if strat.get("kind") == "placement_group":
+            pg_id = strat.get("pg", "")
+            want = strat.get("bundle", -1)
+            keys = [k for k in self._pg_bundles
+                    if k[0] == pg_id and (want < 0 or k[1] == want)]
+            for key in sorted(keys, key=lambda k: k[1]):
+                avail = self._pg_bundles[key]["avail"]
+                if all(avail.get(r, 0.0) + 1e-9 >= v for r, v in resources.items()):
+                    for r, v in resources.items():
+                        avail[r] = avail.get(r, 0.0) - v
+                    return ("bundle", key, resources)
+            return None
+        if self._try_acquire(resources):
+            return ("node", None, resources)
+        return None
+
+    def _release_token(self, token: Tuple[str, Any, Dict[str, float]]) -> None:
+        kind, key, resources = token
+        if kind == "bundle":
+            rec = self._pg_bundles.get(key)
+            if rec is not None:
+                for r, v in resources.items():
+                    rec["avail"][r] = rec["avail"].get(r, 0.0) + v
+        else:
+            self._release_resources(resources)
+
+    def _reacquire_token(self, token: Tuple[str, Any, Dict[str, float]]) -> None:
+        """Forcible re-acquire after a blocked worker resumes: brief
+        oversubscription beats deadlock."""
+        kind, key, resources = token
+        if kind == "bundle":
+            rec = self._pg_bundles.get(key)
+            if rec is not None:
+                for r, v in resources.items():
+                    rec["avail"][r] = rec["avail"].get(r, 0.0) - v
+        else:
+            for k, v in resources.items():
+                self.available[k] = self.available.get(k, 0.0) - v
 
     async def _store_error(self, spec: Dict[str, Any], message: str,
                            error_type: str = "TaskError") -> None:
@@ -586,26 +685,28 @@ class NodeAgent:
 
     # ---------------------------------------------------------------- actors
     async def rpc_start_actor(self, spec: Dict[str, Any]) -> Dict[str, Any]:
-        resources = spec.get("resources") or {}
-        if not self._try_acquire(resources):
+        token = self._acquire_for_spec(spec)
+        if token is None:
             return {"ok": False, "retryable": True, "reason": "busy", "error": "resources busy"}
         try:
             w = await self._lease_worker()
         except TimeoutError as e:
-            self._release_resources(resources)
+            self._release_token(token)
             return {"ok": False, "retryable": True, "error": str(e)}
         w.state = "ACTOR"
         w.actor_id = spec["actor_id"]
-        w._actor_resources = resources
+        w._actor_token = token
         try:
             result = await w.client.call("start_actor", spec=spec, timeout=None)
         except (RpcConnectionError, RpcError) as e:
-            self._release_resources(resources)
+            self._release_token(token)
+            w._actor_token = None
             await self._on_worker_death(w)
             return {"ok": False, "retryable": True, "error": str(e)}
         if not result.get("ok"):
             # constructor raised: creation error object stored by worker
-            self._release_resources(resources)
+            self._release_token(token)
+            w._actor_token = None
             w.state = "IDLE"
             w.actor_id = None
             self._idle_workers.append(w)
@@ -628,10 +729,10 @@ class NodeAgent:
                     w.proc.kill()
                 except Exception:  # noqa: BLE001
                     pass
-                res = getattr(w, "_actor_resources", None)
-                if res:
-                    self._release_resources(res)
-                    w._actor_resources = None
+                token = w._actor_token
+                if token is not None:
+                    self._release_token(token)
+                    w._actor_token = None
                 return True
         return False
 
@@ -653,18 +754,17 @@ class NodeAgent:
         dependent tasks can run (reference: raylet releases CPUs for workers
         blocked in ray.get — prevents nested-task deadlock)."""
         w = self._workers.get(worker_id)
-        if w is not None and w.state == "LEASED" and w.lease_resources and not w.blocked:
+        if w is not None and w.state == "LEASED" and w.lease_token and not w.blocked:
             w.blocked = True
-            self._release_resources(w.lease_resources)
+            self._release_token(w.lease_token)
         return True
 
     async def rpc_worker_unblocked(self, worker_id: str) -> bool:
         w = self._workers.get(worker_id)
-        if w is not None and w.blocked and w.lease_resources:
+        if w is not None and w.blocked and w.lease_token:
             w.blocked = False
             # reacquire without waiting: brief oversubscription beats deadlock
-            for k, v in w.lease_resources.items():
-                self.available[k] = self.available.get(k, 0.0) - v
+            self._reacquire_token(w.lease_token)
         return True
 
     async def rpc_ping(self) -> str:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import weakref
 from typing import Any, Dict, List, Optional, Union
 
 import cloudpickle
@@ -34,16 +35,23 @@ _VALID_TASK_OPTIONS = {
     "max_calls", "_metadata",
 }
 
-_fd_cache: Dict[int, FunctionDescriptor] = {}
+# Keyed by a weak reference to the function object itself: the cache entry
+# dies with the function, so a new function that CPython allocates at a
+# recycled id() can never inherit a dead function's descriptor (which would
+# make workers silently execute the wrong code).
+_fd_cache: "weakref.WeakKeyDictionary[Any, FunctionDescriptor]" = weakref.WeakKeyDictionary()
 _fd_lock = threading.Lock()
 
 
 def make_function_descriptor(func: Any, is_class: bool = False) -> FunctionDescriptor:
-    key = id(func)
-    with _fd_lock:
-        fd = _fd_cache.get(key)
+    try:
+        with _fd_lock:
+            fd = _fd_cache.get(func)
         if fd is not None:
             return fd
+        cacheable = True
+    except TypeError:
+        cacheable = False  # unhashable/non-weakrefable callable: skip caching
     try:
         payload = cloudpickle.dumps(func)
         fid = hashlib.sha1(payload).hexdigest()
@@ -55,8 +63,12 @@ def make_function_descriptor(func: Any, is_class: bool = False) -> FunctionDescr
         function_id=fid,
         is_class=is_class,
     )
-    with _fd_lock:
-        _fd_cache[key] = fd
+    if cacheable:
+        try:
+            with _fd_lock:
+                _fd_cache[func] = fd
+        except TypeError:
+            pass
     return fd
 
 

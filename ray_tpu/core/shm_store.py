@@ -265,16 +265,32 @@ class ShmObjectStore:
                 path = e.spilled_path
                 size = e.size
                 self._ensure_capacity(size)
-            data = open(path, "rb").read()
-            writer = ShmWriter(oid, len(data), self.node_suffix)
-            writer.buffer[:] = data
-            writer.seal()
+                # reserve the headroom BEFORE dropping the lock: a concurrent
+                # reserve() must not claim the same bytes (mirror of
+                # reserve()'s reserve-then-write pattern)
+                self._used += size
+            try:
+                data = open(path, "rb").read()
+                writer = ShmWriter(oid, len(data), self.node_suffix)
+                writer.buffer[:] = data
+                writer.seal()
+            except Exception:
+                with self._lock:
+                    self._used -= size
+                raise
+            deleted = False
             with self._lock:
                 e = self._entries.get(oid)
                 if e is not None:
                     e.spilled_path = None
-                    self._used += size
                     self._entries.move_to_end(oid)
+                else:
+                    self._used -= size  # deleted while restoring
+                    deleted = True
+            if deleted:
+                # delete() ran before our segment existed: unlink the one we
+                # just wrote or it leaks in /dev/shm forever
+                self._unlink(oid)
             try:
                 os.unlink(path)
             except OSError:

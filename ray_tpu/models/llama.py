@@ -138,6 +138,27 @@ def llama_init(config: LlamaConfig, key) -> Dict[str, Any]:
     return params
 
 
+def _attention_dispatch(config: LlamaConfig, rules: ShardingRules, mesh, q, k, v):
+    """Route attention by parallelism layout: with the sequence sharded over
+    a >1-sized cp mesh axis, plain (flash) attention can't see the full
+    sequence — use ring attention (ppermute K/V ring, O(S/cp) memory per
+    device). Otherwise the fused flash path."""
+    seq_axis = rules.lookup("seq")
+    if (
+        mesh is not None
+        and isinstance(seq_axis, str)
+        and dict(mesh.shape).get(seq_axis, 1) > 1
+    ):
+        from ray_tpu.parallel.ring_attention import ring_attention_sharded
+
+        return ring_attention_sharded(
+            q, k, v, mesh, causal=True, axis_name=seq_axis,
+            q_spec=rules.spec(("batch", "seq", "act_heads", "head_dim")),
+            kv_spec=rules.spec(("batch", "seq", "act_kv_heads", "head_dim")),
+        )
+    return attention(q, k, v, causal=True, impl=config.attention_impl)
+
+
 def _layer(
     config: LlamaConfig,
     rules: ShardingRules,
@@ -165,7 +186,7 @@ def _layer(
     k = cstr(k, ("batch", "seq", "act_kv_heads", "head_dim"))
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    o = attention(q, k, v, causal=True, impl=config.attention_impl)
+    o = _attention_dispatch(config, rules, mesh, q, k, v)
     o = o.reshape(b, s, nh * hd)
     x = x + cstr(o @ lp["wo"], ("batch", "seq", "act_embed"))
 
@@ -189,9 +210,18 @@ def llama_forward(
     b, s = tokens.shape
     cos, sin = rope_frequencies(config.head_dim_, s, config.rope_theta)
 
-    x = params["embed_tokens"][tokens].astype(config.dtype)
+    table = params["embed_tokens"]
     if mesh is not None:
+        # One-hot matmul instead of gather: the table is sharded
+        # (vocab->tp, embed->fsdp) and a gather from it forces SPMD full
+        # rematerialization (replicate-then-repartition). The one-hot
+        # contraction over vocab partitions cleanly (psum over tp) and rides
+        # the MXU — the standard TPU embedding pattern.
+        onehot = jax.nn.one_hot(tokens, config.vocab_size, dtype=config.dtype)
+        x = onehot @ table.astype(config.dtype)
         x = shard_constraint(x, mesh, rules, ("batch", "seq", "act_embed"))
+    else:
+        x = table[tokens].astype(config.dtype)
 
     layer_fn = functools.partial(_layer, config, rules, mesh, cos, sin)
     if config.remat == "full":
@@ -217,9 +247,15 @@ def llama_forward(
 
 
 def cross_entropy_loss(logits, targets, mask=None):
-    """logits: [B, S, V] fp32; targets: [B, S] int32."""
+    """logits: [B, S, V] fp32; targets: [B, S] int32.
+
+    The gold-logit pick is a one-hot select-reduce, not take_along_axis: a
+    gather over the tp-sharded vocab axis would force SPMD replication; the
+    masked sum partitions cleanly (local select + psum)."""
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    vocab = logits.shape[-1]
+    onehot = jax.nn.one_hot(targets, vocab, dtype=logits.dtype)
+    gold = jnp.sum(logits * onehot, axis=-1)
     nll = logz - gold
     if mask is not None:
         nll = nll * mask

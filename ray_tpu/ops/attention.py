@@ -9,9 +9,11 @@ Design (see /opt/skills/guides/pallas_guide.md):
   skip), and applies an elementwise mask only on the diagonal block.
 - GQA: q heads map onto kv heads through the BlockSpec index_map
   (h // q_per_kv), so kv tensors are never materialized per-q-head.
-- backward: custom_vjp recomputes with the jnp reference (correct, memory
-  O(S²) transient inside XLA); a Pallas backward kernel is the planned
-  upgrade.
+- backward: Pallas kernels with the standard flash-bwd recurrence — the
+  forward also emits the logsumexp per row; bwd recomputes p = exp(qk−lse)
+  blockwise, so S×S never materializes. Two kernels: dq (grid over q blocks)
+  and dk/dv (grid over k blocks, accumulated at q-head granularity then
+  reduced onto kv heads for GQA).
 
 Replaces-the-capability-of: the reference's NCCL-attached attention stacks
 are external (DeepSpeed etc. via train integrations); here attention is a
@@ -63,7 +65,7 @@ def reference_attention(q, k, v, causal: bool = True, scale: Optional[float] = N
 # --------------------------------------------------------------------------- #
 # Pallas forward kernel
 # --------------------------------------------------------------------------- #
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, seq_kv, causal, scale, offset):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_q, block_k, seq_kv, causal, scale, offset):
     # refs carry leading (1, 1) batch/head block dims:
     # q_ref: [1, 1, block_q, D]; k_ref/v_ref: [1, 1, seq_kv, D]
     # offset = seq_kv - seq_q: query row i sits at absolute position offset+i
@@ -107,10 +109,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, seq_kv, c
 
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
-    """q: [B, Sq, Hq, D] -> [B, Sq, Hq, D]. Requires Sq % block_q == 0 and
+def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
+               interpret: bool, with_lse: bool = True):
+    """q: [B, Sq, Hq, D] -> (out [B, Sq, Hq, D], lse [B, Hq, Sq, 1] fp32 or
+    None). lse carries a trailing singleton so its blocks satisfy the TPU
+    (8, 128) tiling rule; inference-only callers pass with_lse=False to skip
+    the extra HBM write entirely. Requires Sq % block_q == 0 and
     Skv % block_k == 0 (caller pads)."""
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -130,35 +138,213 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, 
         scale=scale,
         offset=skv - sq,
     )
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
-            pl.BlockSpec((1, 1, skv, d), lambda bb, h, i, _g=q_per_kv: (bb, h // _g, 0, 0)),
-            pl.BlockSpec((1, 1, skv, d), lambda bb, h, i, _g=q_per_kv: (bb, h // _g, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0)),
+        pl.BlockSpec((1, 1, skv, d), lambda bb, h, i, _g=q_per_kv: (bb, h // _g, 0, 0)),
+        pl.BlockSpec((1, 1, skv, d), lambda bb, h, i, _g=q_per_kv: (bb, h // _g, 0, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0))
+    if with_lse:
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[
+                o_spec,
+                pl.BlockSpec((1, 1, block_q, 1), lambda bb, h, i: (bb, h, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(qt.shape, q.dtype),
+                jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qt, kt, vt)
+    else:
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=o_spec,
+            out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            interpret=interpret,
+        )(qt, kt, vt)
+        lse = None
+    return out.transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                         *, block_q, block_k, seq_kv, causal, scale, offset):
+    """dQ for one (batch, q_head, q_block): stream K/V blocks, recompute
+    p = exp(s - lse), ds = p * (dO·Vᵀ - delta), dq += scale · ds · K."""
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # [block_q, 1]
+    delta = delta_ref[0, 0]  # [block_q, 1]
+    d = q.shape[-1]
+
+    q_start = qi * block_q + offset
+    if causal:
+        num_k_blocks = jax.lax.div(
+            jnp.minimum(q_start + block_q, seq_kv) + block_k - 1, block_k
+        )
+    else:
+        num_k_blocks = seq_kv // block_k
+
+    def body(j, dq):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q, block_k, seq_q, causal,
+                          scale, offset):
+    """dK/dV for one (batch, q_head, k_block): stream q blocks from the first
+    causally-visible one. Accumulated per Q head; the caller reduces onto kv
+    heads (GQA)."""
+    ki = pl.program_id(2)
+    k_blk = k_ref[0, 0].astype(jnp.float32)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    d = k_blk.shape[-1]
+    k_start = ki * block_k
+
+    num_q_blocks = seq_q // block_q
+    if causal:
+        # first q block whose LAST row (abs pos offset + i*bq + bq - 1) can
+        # see this k block: i >= (k_start - offset) / bq
+        first = jax.lax.max(0, jax.lax.div(k_start - offset, block_q))
+    else:
+        first = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]  # [bq, 1]
+        delta_blk = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = offset + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_blk)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_blk) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret):
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    q_per_kv = hq // hkv
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = g.transpose(0, 2, 1, 3)
+    # delta_i = sum_d dO_i · O_i  (the softmax-jacobian row correction)
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", g.astype(jnp.float32), out.astype(jnp.float32)
+    )[..., None]
+    offset = skv - sq
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bb, h, i: (bb, h, i, 0))
+    q_full = pl.BlockSpec((1, 1, sq, d), lambda bb, h, i: (bb, h, 0, 0))
+    kv_full = pl.BlockSpec((1, 1, skv, d), lambda bb, h, i, _g=q_per_kv: (bb, h // _g, 0, 0))
+    kv_blk = pl.BlockSpec((1, 1, block_k, d), lambda bb, h, j, _g=q_per_kv: (bb, h // _g, j, 0))
+    row_blk = pl.BlockSpec((1, 1, block_q, 1), lambda bb, h, i: (bb, h, i, 0))
+    row_full = pl.BlockSpec((1, 1, sq, 1), lambda bb, h, i: (bb, h, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            seq_kv=skv, causal=causal, scale=scale, offset=offset,
+        ),
+        grid=(b, hq, sq // block_q),
+        in_specs=[q_spec, kv_full, kv_full, q_spec, row_blk, row_blk],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    )(qt, kt, vt, dot, lse, delta)
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            seq_q=sq, causal=causal, scale=scale, offset=offset,
+        ),
+        grid=(b, hq, skv // block_k),
+        in_specs=[q_full, kv_blk, kv_blk, q_full, row_full, row_full],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, h, j: (bb, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, h, j: (bb, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, skv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, skv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # GQA reduction: q-head-granular dk/dv sum onto their kv head
+    dk = dk_h.reshape(b, hkv, q_per_kv, skv, d).sum(axis=2)
+    dv = dv_h.reshape(b, hkv, q_per_kv, skv, d).sum(axis=2)
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3).astype(k.dtype),
+        dv.transpose(0, 2, 1, 3).astype(v.dtype),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                        with_lse=False)
+    return out
 
 
 def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_attention_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: reference_attention(q_, k_, v_, causal, scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)

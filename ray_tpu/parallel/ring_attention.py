@@ -109,9 +109,16 @@ def ring_attention(q, k, v, axis_name: str = "cp", causal: bool = True,
 
 
 def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
-                           scale: Optional[float] = None, axis_name: str = "cp"):
+                           scale: Optional[float] = None, axis_name: str = "cp",
+                           q_spec=None, kv_spec=None):
     """shard_map wrapper: q/k/v are GLOBAL [B, S, H, D] arrays (sharded or
-    not); sequence is split over the cp axis inside."""
+    not); sequence is split over the cp axis inside.
+
+    ``q_spec``/``kv_spec`` are optional PartitionSpecs carrying the FULL
+    layout (batch over dp/fsdp, heads over tp, seq over cp). Attention is
+    independent across batch and heads, so only the cp axis participates in
+    the ring; passing the real specs keeps dp/tp sharding intact instead of
+    forcing replication at the shard_map boundary."""
     from jax.sharding import PartitionSpec as P
 
     try:
@@ -123,6 +130,9 @@ def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
 
         wrap = functools.partial(_sm, check_rep=False)
 
-    spec = P(None, axis_name, None, None)
+    if q_spec is None:
+        q_spec = P(None, axis_name, None, None)
+    if kv_spec is None:
+        kv_spec = q_spec
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal, scale=scale)
-    return wrap(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+    return wrap(fn, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec)(q, k, v)

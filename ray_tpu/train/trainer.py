@@ -254,9 +254,11 @@ class TpuTrainer:
                     break
                 rank0 = round_results[0]
                 history.append(rank0["metrics"])
-                ckpts = [r.get("checkpoint") for r in round_results if r.get("checkpoint")]
-                if ckpts:
-                    latest_checkpoint = ckpts[0]  # rank-0 ordering
+                ckpts = [r.get("checkpoint") for r in round_results]
+                if ckpts and ckpts[0]:
+                    latest_checkpoint = ckpts[0]  # rank 0's checkpoint wins
+                elif any(ckpts):
+                    latest_checkpoint = next(c for c in ckpts if c)
                 self._apply_keep_policy(trial_dir)
             if final_error is not None:
                 raise _AttemptFailed(final_error, latest_checkpoint)
@@ -284,12 +286,23 @@ class TpuTrainer:
             return
         import shutil
 
-        entries = sorted(
-            (e for e in os.listdir(trial_dir) if e.startswith("checkpoint_")),
-            key=lambda e: os.path.getmtime(os.path.join(trial_dir, e)),
+        # Dirs are checkpoint_<step>_rank<r>: group per STEP so num_to_keep
+        # counts checkpoints, not per-rank shards (W ranks would otherwise
+        # shrink the window to num_to_keep/W steps).
+        groups: Dict[str, List[str]] = {}
+        for entry in os.listdir(trial_dir):
+            if not entry.startswith("checkpoint_"):
+                continue
+            step_key = entry.split("_rank")[0]
+            groups.setdefault(step_key, []).append(entry)
+        ordered = sorted(
+            groups,
+            key=lambda s: max(os.path.getmtime(os.path.join(trial_dir, e))
+                              for e in groups[s]),
         )
-        for stale in entries[:-keep]:
-            shutil.rmtree(os.path.join(trial_dir, stale), ignore_errors=True)
+        for stale_step in ordered[:-keep]:
+            for entry in groups[stale_step]:
+                shutil.rmtree(os.path.join(trial_dir, entry), ignore_errors=True)
 
 
 class _AttemptFailed(Exception):

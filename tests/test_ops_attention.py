@@ -131,3 +131,28 @@ def test_flash_causal_requires_kv_longer():
     k = jnp.zeros((1, 64, 2, 32), jnp.float32)
     with pytest.raises(ValueError, match="Skv >= Sq"):
         flash_attention(q, k, k, causal=True, interpret=True)
+
+
+def test_flash_gradient_gqa_causal():
+    """Backward kernels under GQA (Hq=4, Hkv=2): dk/dv reduce over the
+    q-head group; compare against the reference vjp."""
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((2, 128, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 128, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 128, 2, 32)), jnp.float32)
+
+    with jax.default_matmul_precision("highest"):
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, causal=True, interpret=True,
+                                  block_q=64, block_k=64)
+            return (out * out).sum()
+
+        def loss_ref(q, k, v):
+            out = reference_attention(q, k, v, causal=True)
+            return (out * out).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)

@@ -1,0 +1,71 @@
+"""ActorPool tests (reference analogue: python/ray/tests/test_actor_pool.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, v):
+        return 2 * v
+
+    def slow_double(self, v):
+        import time
+
+        time.sleep(0.05 * (3 - v % 3))
+        return 2 * v
+
+
+@pytest.fixture
+def pool(ray_tpu_local):
+    return ActorPool([_Doubler.remote() for _ in range(2)])
+
+
+def test_map_ordered(pool):
+    assert list(pool.map(lambda a, v: a.double.remote(v), range(6))) == [
+        0, 2, 4, 6, 8, 10,
+    ]
+
+
+def test_map_unordered(pool):
+    out = list(pool.map_unordered(lambda a, v: a.slow_double.remote(v), range(6)))
+    assert sorted(out) == [0, 2, 4, 6, 8, 10]
+
+
+def test_submit_backlog_exceeds_pool(pool):
+    # more submissions than actors: the backlog drains as actors free up
+    for v in range(10):
+        pool.submit(lambda a, v: a.double.remote(v), v)
+    results = []
+    while pool.has_next():
+        results.append(pool.get_next())
+    assert results == [2 * v for v in range(10)]
+
+
+def test_mixed_ordered_unordered(pool):
+    for v in range(4):
+        pool.submit(lambda a, v: a.double.remote(v), v)
+    first_unordered = pool.get_next_unordered()
+    rest = []
+    while pool.has_next():
+        rest.append(pool.get_next())
+    assert sorted(rest + [first_unordered]) == [0, 2, 4, 6]
+
+
+def test_get_next_empty_raises(pool):
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_push_and_idle(ray_tpu_local):
+    a, b = _Doubler.remote(), _Doubler.remote()
+    pool = ActorPool([a])
+    assert pool.has_free()
+    idle = pool.pop_idle()
+    assert idle is not None and not pool.has_free()
+    pool.push(idle)
+    pool.push(b)
+    assert pool.has_free()
+    assert list(pool.map(lambda ac, v: ac.double.remote(v), range(4))) == [0, 2, 4, 6]
