@@ -1,0 +1,106 @@
+"""Serve benchmark: continuous-batched LLM decode req/s + p50 TTFT.
+
+Prints ONE JSON line (the Serve half of BASELINE.json's headline metric:
+"Ray Serve req/s + p50 TTFT"). The reference publishes no TPU serving
+numbers, so vs_baseline is throughput relative to the engine's own decode
+roofline: slots * (1 / per-token step time at full batch) — i.e. how close
+continuous batching gets to the hardware's sequential decode ceiling.
+
+Drives the engine DIRECTLY (in-process, the replica's own view): closed-loop
+clients with think-time zero, mixed prompt lengths, fixed token budget.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import concurrent.futures as cf
+
+    import numpy as np
+
+    import jax
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.llm import LLMEngine
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        config = LlamaConfig.llama_1b(max_seq_len=2048, attention_impl="flash")
+        num_slots, decode_chunk = 32, 32
+        num_requests, max_tokens = 96, 64
+        prompt_lens = [32, 64, 128, 256]
+        clients = 48
+    else:
+        config = LlamaConfig.tiny(remat=None, attention_impl="reference")
+        num_slots, decode_chunk = 4, 4
+        num_requests, max_tokens = 8, 8
+        prompt_lens = [8, 16]
+        clients = 4
+
+    engine = LLMEngine(
+        config, num_slots=num_slots, decode_chunk=decode_chunk,
+        max_seq_len=min(2048, config.max_seq_len),
+        prefill_buckets=[64, 256, 512],
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, config.vocab_size, rng.choice(prompt_lens)).tolist()
+        for _ in range(num_requests)
+    ]
+
+    # warmup: compile prefill buckets + decode program
+    engine.generate(prompts[0][:32], max_tokens=decode_chunk, timeout=600)
+
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(max_workers=clients) as pool:
+        results = list(pool.map(
+            lambda p: engine.generate(p, max_tokens=max_tokens, timeout=600),
+            prompts,
+        ))
+    wall = time.perf_counter() - t0
+    engine.stop()
+
+    ttfts = sorted(r["ttft_s"] for r in results)
+    p50 = ttfts[len(ttfts) // 2]
+    p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+    req_s = num_requests / wall
+    tok_s = sum(len(r["tokens"]) for r in results) / wall
+
+    # roofline: steady-state full-batch decode throughput measured in-situ
+    st = engine.stats()
+    decode_tok_ceiling = None
+    vs = None
+    if st["decode_steps"]:
+        # tokens the engine COULD have emitted had every slot stayed busy
+        decode_tok_ceiling = st["decode_steps"] * num_slots / wall
+        vs = round(tok_s / max(decode_tok_ceiling, 1e-9), 4)
+
+    print(json.dumps({
+        "metric": "serve_llm_continuous_batching",
+        "value": round(req_s, 2),
+        "unit": "req/s",
+        "vs_baseline": vs if vs is not None else 0.0,
+        "p50_ttft_s": round(p50, 4),
+        "p99_ttft_s": round(p99, 4),
+        "tokens_per_sec": round(tok_s, 1),
+        "requests": num_requests,
+        "max_tokens": max_tokens,
+        "slots": num_slots,
+        "model_params": config.num_params,
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 - always emit a JSON line
+        print(json.dumps({
+            "metric": "serve_llm_continuous_batching",
+            "value": 0, "unit": "req/s", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        sys.exit(0)

@@ -114,13 +114,14 @@ class MapStage(Stage):
 
         from ray_tpu.util.actor_pool import ActorPool
 
-        pool = ActorPool([_MapWorker.remote() for _ in range(n)])
+        actors = [_MapWorker.remote() for _ in range(n)]
+        pool = ActorPool(actors)
         try:
             for out in pool.map(lambda a, ref: a.apply.remote(ref), inputs):
                 # ActorPool.map yields VALUES; re-put to keep the ref stream
                 yield ray_tpu.put(out)
         finally:
-            for a in list(pool._idle):
+            for a in actors:
                 try:
                     ray_tpu.kill(a)
                 except Exception:  # noqa: BLE001

@@ -143,7 +143,7 @@ def _attention_dispatch(config: LlamaConfig, rules: ShardingRules, mesh, q, k, v
     a >1-sized cp mesh axis, plain (flash) attention can't see the full
     sequence — use ring attention (ppermute K/V ring, O(S/cp) memory per
     device). Otherwise the fused flash path."""
-    seq_axis = rules.lookup("seq")
+    seq_axis = rules.lookup("seq") if rules is not None else None
     if (
         mesh is not None
         and isinstance(seq_axis, str)

@@ -71,7 +71,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_q, bloc
     # offset = seq_kv - seq_q: query row i sits at absolute position offset+i
     # (the KV-cache decode case where cached keys precede the queries).
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale
+    # operands stay in their storage dtype (bf16 on the hot path — the MXU
+    # runs bf16 x bf16 at 2x the f32 rate); accumulation is f32 via
+    # preferred_element_type, scale applied post-dot in f32.
+    q = q_ref[0, 0]
     d = q.shape[-1]
 
     q_start = qi * block_q + offset
@@ -89,11 +92,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_q, bloc
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        ) * scale  # [bq, bk] f32
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -103,7 +106,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_q, bloc
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
@@ -177,10 +181,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
     """dQ for one (batch, q_head, q_block): stream K/V blocks, recompute
     p = exp(s - lse), ds = p * (dO·Vᵀ - delta), dq += scale · ds · K."""
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]  # [block_q, 1]
-    delta = delta_ref[0, 0]  # [block_q, 1]
+    q = q_ref[0, 0]  # storage dtype: bf16 dots on the MXU, f32 accumulate
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]  # [block_q, 1] f32
+    delta = delta_ref[0, 0]  # [block_q, 1] f32
     d = q.shape[-1]
 
     q_start = qi * block_q + offset
@@ -192,8 +196,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
         num_k_blocks = seq_kv // block_k
 
     def body(j, dq):
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -205,7 +209,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -221,8 +225,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     causally-visible one. Accumulated per Q head; the caller reduces onto kv
     heads (GQA)."""
     ki = pl.program_id(2)
-    k_blk = k_ref[0, 0].astype(jnp.float32)
-    v_blk = v_ref[0, 0].astype(jnp.float32)
+    k_blk = k_ref[0, 0]  # storage dtype (bf16 MXU path)
+    v_blk = v_ref[0, 0]
     d = k_blk.shape[-1]
     k_start = ki * block_k
 
@@ -236,8 +240,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
         lse_blk = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]  # [bq, 1]
         delta_blk = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
         s = jax.lax.dot_general(
@@ -250,13 +254,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse_blk)
+        p_lo = p.astype(do_blk.dtype)
         dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_lo, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_blk) * scale
+        ds = (p * (dp - delta_blk) * scale).astype(q_blk.dtype)
         dk = dk + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )

@@ -1,0 +1,39 @@
+"""ray_tpu.serve — model/application serving over the actor runtime.
+
+Reference capability: python/ray/serve (controller, proxy, replicas, pow-2
+routing, dynamic batching, autoscaling) re-designed TPU-first: the flagship
+deployment is a continuous-batched LLM decode engine (serve.llm) with a
+slotted KV cache resident in HBM and one compiled step per decode tick.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    http_address,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "http_address",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

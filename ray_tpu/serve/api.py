@@ -1,0 +1,131 @@
+"""serve public API: run/shutdown/status/get_handle.
+
+Reference capability: serve/api.py (serve.run:565, serve.start,
+serve.shutdown, serve.status) — here the controller + proxy are named actors
+in the "serve" namespace so every driver/worker in the cluster reaches the
+same instance.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.proxy import ProxyActor
+
+_CONTROLLER_NAME = "SERVE_CONTROLLER"
+_PROXY_NAME = "SERVE_PROXY"
+_NAMESPACE = "serve"
+
+_state: Dict[str, Any] = {"controller": None, "proxy": None}
+
+
+def start(http_host: str = "127.0.0.1", http_port: int = 8000,
+          http: bool = True):
+    """Idempotently start the serve instance (controller + proxy actors)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    controller = _state.get("controller")
+    if controller is None:
+        try:
+            controller = ray_tpu.get_actor(_CONTROLLER_NAME, namespace=_NAMESPACE)
+        except ValueError:
+            controller = (
+                ray_tpu.remote(ServeController)
+                .options(name=_CONTROLLER_NAME, namespace=_NAMESPACE,
+                         max_concurrency=32)
+                .remote()
+            )
+        _state["controller"] = controller
+    if http and _state.get("proxy") is None:
+        try:
+            proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=_NAMESPACE)
+        except ValueError:
+            proxy = (
+                ray_tpu.remote(ProxyActor)
+                .options(name=_PROXY_NAME, namespace=_NAMESPACE, max_concurrency=8)
+                .remote(controller, http_host, http_port)
+            )
+        _state["proxy"] = proxy
+    return controller
+
+
+def run(app: Application, name: Optional[str] = None, *,
+        http: bool = True, http_port: int = 8000,
+        wait_for_ready: bool = True, timeout: float = 120.0) -> DeploymentHandle:
+    """Deploy an application; returns its handle (reference: serve.run)."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    controller = start(http_port=http_port, http=http)
+    app_name = name or app.deployment.name
+    ray_tpu.get(
+        controller.deploy.remote(
+            app_name,
+            cloudpickle.dumps(app.deployment),
+            cloudpickle.dumps((app.init_args, app.init_kwargs)),
+        ),
+        timeout=60,
+    )
+    if wait_for_ready:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if ray_tpu.get(controller.wait_ready.remote(app_name), timeout=60):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(f"app '{app_name}' not ready after {timeout}s")
+    return DeploymentHandle(controller, app_name)
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    controller = start(http=False)
+    return DeploymentHandle(controller, name)
+
+
+def get_deployment_handle(deployment_name: str, app_name: Optional[str] = None) -> DeploymentHandle:
+    return get_app_handle(app_name or deployment_name)
+
+
+def status() -> Dict[str, Any]:
+    controller = _state.get("controller")
+    if controller is None:
+        return {}
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def http_address() -> Optional[str]:
+    proxy = _state.get("proxy")
+    if proxy is None:
+        return None
+    return ray_tpu.get(proxy.address.remote(), timeout=30)
+
+
+def delete(name: str) -> None:
+    controller = _state.get("controller")
+    if controller is not None:
+        ray_tpu.get(controller.delete_app.remote(name), timeout=30)
+
+
+def shutdown() -> None:
+    controller = _state.pop("controller", None)
+    proxy = _state.pop("proxy", None)
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=30)
+            ray_tpu.kill(controller)
+        except Exception:  # noqa: BLE001
+            pass
+    if proxy is not None:
+        try:
+            ray_tpu.kill(proxy)
+        except Exception:  # noqa: BLE001
+            pass
+    from ray_tpu.serve import handle as _handle
+
+    _handle._routers.clear()

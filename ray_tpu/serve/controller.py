@@ -1,0 +1,243 @@
+"""Serve controller: the control plane actor.
+
+Reference capability: serve/_private/controller.py (ServeController:84, the
+reconciliation control loop run_control_loop:370) + autoscaling_state.py:262
+(queue-depth scaling decisions) + deployment_state.py (target vs running
+replica reconciliation). One named actor per serve instance:
+
+- holds the declarative target state {app name -> deployment spec + args}
+- reconciles: starts/stops Replica actors to match target counts
+- health-checks replicas, replacing dead ones
+- autoscales deployments with an AutoscalingConfig on mean ongoing requests
+  per replica (scrapes replica stats each tick)
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.controller")
+
+CONTROL_LOOP_PERIOD_S = 0.5
+
+
+class ServeController:
+    def __init__(self):
+        # app -> record
+        self._apps: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._shutdown = False
+        self._loop_thread = threading.Thread(
+            target=self._control_loop, daemon=True, name="serve-control-loop"
+        )
+        self._loop_thread.start()
+
+    # ------------------------------------------------------------ target API
+    def deploy(self, app_name: str, deployment_def: bytes, init_args: bytes) -> bool:
+        """Set/replace an application's target state. Replicas are created by
+        the control loop (deploy returns once the target is recorded; callers
+        poll wait_ready)."""
+        dep = cloudpickle.loads(deployment_def)
+        with self._lock:
+            old = self._apps.get(app_name)
+            self._apps[app_name] = {
+                "deployment_def": deployment_def,
+                "deployment": dep,
+                "init_args": init_args,
+                "target": dep.target_replicas,
+                "replicas": old["replicas"] if old else [],
+                "next_replica_idx": old["next_replica_idx"] if old else 0,
+                "last_scale_up": 0.0,
+                "last_scale_down": 0.0,
+                "ongoing_history": [],
+            }
+            # config-only change (num_replicas / user_config): keep replicas,
+            # reconfigure in place
+            if old is not None:
+                for r in old["replicas"]:
+                    if dep.user_config is not None:
+                        try:
+                            r.reconfigure.remote(dep.user_config)
+                        except Exception:  # noqa: BLE001
+                            pass
+        return True
+
+    def delete_app(self, app_name: str) -> bool:
+        with self._lock:
+            rec = self._apps.pop(app_name, None)
+        if rec:
+            for r in rec["replicas"]:
+                self._stop_replica(r)
+        return True
+
+    def get_replicas(self, app_name: str) -> List[Any]:
+        with self._lock:
+            rec = self._apps.get(app_name)
+            return list(rec["replicas"]) if rec else []
+
+    def list_apps(self) -> List[str]:
+        with self._lock:
+            return list(self._apps)
+
+    def status(self) -> Dict[str, Any]:
+        out = {}
+        with self._lock:
+            apps = {name: (rec["target"], list(rec["replicas"]))
+                    for name, rec in self._apps.items()}
+        for name, (target, replicas) in apps.items():
+            stats = []
+            for r in replicas:
+                try:
+                    stats.append(ray_tpu.get(r.stats.remote(), timeout=2))
+                except Exception:  # noqa: BLE001
+                    stats.append({"ongoing": -1})
+            out[name] = {
+                "target_replicas": target,
+                "running_replicas": len(replicas),
+                "replica_stats": stats,
+            }
+        return out
+
+    def wait_ready(self, app_name: str) -> bool:
+        """True once at least one replica is alive and answering."""
+        with self._lock:
+            rec = self._apps.get(app_name)
+            replicas = list(rec["replicas"]) if rec else []
+        for r in replicas:
+            try:
+                ray_tpu.get(r.check_health.remote(), timeout=30)
+                return True
+            except Exception:  # noqa: BLE001
+                continue
+        return False
+
+    def shutdown(self) -> bool:
+        self._shutdown = True
+        with self._lock:
+            apps = list(self._apps.values())
+            self._apps.clear()
+        for rec in apps:
+            for r in rec["replicas"]:
+                self._stop_replica(r)
+        return True
+
+    # ---------------------------------------------------------- control loop
+    def _control_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(CONTROL_LOOP_PERIOD_S)
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 - the loop must never die
+                logger.exception("serve control loop error")
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            apps = list(self._apps.items())
+        for name, rec in apps:
+            self._health_check(name, rec)
+            self._autoscale(name, rec)
+            self._scale_to_target(name, rec)
+
+    def _health_check(self, name: str, rec: Dict[str, Any]) -> None:
+        dead = []
+        for r in list(rec["replicas"]):
+            try:
+                ray_tpu.get(r.check_health.remote(), timeout=10)
+            except Exception:  # noqa: BLE001
+                dead.append(r)
+        if dead:
+            with self._lock:
+                for r in dead:
+                    if r in rec["replicas"]:
+                        rec["replicas"].remove(r)
+            logger.warning("serve app %s: %d replica(s) failed health check",
+                           name, len(dead))
+
+    def _autoscale(self, name: str, rec: Dict[str, Any]) -> None:
+        cfg = rec["deployment"].autoscaling_config
+        if cfg is None or not rec["replicas"]:
+            return
+        total_ongoing = 0
+        live = 0
+        for r in rec["replicas"]:
+            try:
+                s = ray_tpu.get(r.stats.remote(), timeout=2)
+                total_ongoing += s["ongoing"]
+                live += 1
+            except Exception:  # noqa: BLE001
+                continue
+        if live == 0:
+            return
+        desired = max(1, math.ceil(total_ongoing / max(cfg.target_ongoing_requests, 1e-9)))
+        desired = min(max(desired, cfg.min_replicas), cfg.max_replicas)
+        now = time.monotonic()
+        with self._lock:
+            current = rec["target"]
+            if desired > current and now - rec["last_scale_up"] >= cfg.upscale_delay_s:
+                rec["target"] = desired
+                rec["last_scale_up"] = now
+                logger.info("autoscale %s: %d -> %d (ongoing=%d)",
+                            name, current, desired, total_ongoing)
+            elif desired < current and now - rec["last_scale_down"] >= cfg.downscale_delay_s:
+                rec["target"] = max(desired, current - 1)  # scale down gently
+                rec["last_scale_down"] = now
+                logger.info("autoscale %s: %d -> %d (ongoing=%d)",
+                            name, current, rec["target"], total_ongoing)
+
+    def _scale_to_target(self, name: str, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            target = rec["target"]
+            current = len(rec["replicas"])
+        for _ in range(current, target):
+            replica = self._start_replica(name, rec)
+            if replica is None:
+                break
+            with self._lock:
+                rec["replicas"].append(replica)
+        if current > target:
+            with self._lock:
+                victims = rec["replicas"][target:]
+                rec["replicas"] = rec["replicas"][:target]
+            for r in victims:
+                self._stop_replica(r)
+
+    def _start_replica(self, name: str, rec: Dict[str, Any]):
+        from ray_tpu.serve.replica import Replica
+
+        dep = rec["deployment"]
+        with self._lock:
+            idx = rec["next_replica_idx"]
+            rec["next_replica_idx"] += 1
+        replica_id = f"{name}#{idx}"
+        init_args, init_kwargs = cloudpickle.loads(rec["init_args"])
+        actor_opts = dict(dep.ray_actor_options)
+        actor_opts.setdefault("max_concurrency", max(dep.max_ongoing_requests * 2, 8))
+        actor_opts.setdefault("max_restarts", 0)
+        try:
+            cls = ray_tpu.remote(Replica)
+            return cls.options(**actor_opts).remote(
+                rec["deployment_def"], init_args, init_kwargs, replica_id
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to start replica %s", replica_id)
+            return None
+
+    def _stop_replica(self, replica) -> None:
+        try:
+            # wait for user cleanup BEFORE killing (a fire-and-forget would
+            # race the kill and never run)
+            ray_tpu.get(replica.prepare_for_shutdown.remote(), timeout=15)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.kill(replica)
+        except Exception:  # noqa: BLE001
+            pass

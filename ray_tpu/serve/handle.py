@@ -1,0 +1,82 @@
+"""DeploymentHandle: python-level calls into a serve application.
+
+Reference capability: serve/handle.py (DeploymentHandle.remote returning a
+DeploymentResponse backed by the replica scheduler). Handles share one Router
+per (process, app): pow-2 routing with queue-length estimates.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.router import Router
+
+_routers: Dict[str, Router] = {}
+_routers_lock = threading.Lock()
+
+
+def _router_for(controller, app_name: str) -> Router:
+    with _routers_lock:
+        r = _routers.get(app_name)
+        if r is None:
+            r = Router(controller, app_name)
+            _routers[app_name] = r
+        return r
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote(). ``.result()`` resolves (with
+    overload retry via the router); ``.ref`` exposes the underlying ObjectRef
+    for composition with ray_tpu.get/wait."""
+
+    def __init__(self, router: Router, ref, replica):
+        self._router = router
+        self._ref = ref
+        self._replica = replica
+        self._done = False
+
+    @property
+    def ref(self):
+        return self._ref
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        from ray_tpu.serve.replica import ReplicaOverloadedError
+
+        try:
+            value = ray_tpu.get(self._ref, timeout=timeout)
+            return value
+        except ReplicaOverloadedError:
+            # raced an overloaded replica: fall back to the router's
+            # retrying call path
+            return self._router.call(self._method, self._args, self._kwargs,
+                                     timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                self._router._note(self._replica, -1)
+
+
+class DeploymentHandle:
+    def __init__(self, controller, app_name: str, method: str = "__call__"):
+        self._controller = controller
+        self._app = app_name
+        self._method = method
+
+    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(self._controller, self._app, method_name or self._method)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._controller, self._app, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = _router_for(self._controller, self._app)
+        ref, replica = router.route(self._method, args, kwargs)
+        resp = DeploymentResponse(router, ref, replica)
+        resp._method = self._method
+        resp._args = args
+        resp._kwargs = kwargs
+        return resp

@@ -1,0 +1,287 @@
+"""Continuous-batched LLM serving engine (the TPU-native Serve flagship).
+
+Reference capability: the reference serves LLMs by orchestrating external
+GPU engines (ray.serve.llm -> vLLM); here the engine IS the framework:
+
+- a slotted KV cache in HBM (models/decode.py) — one slot per in-flight
+  request, no paging tables needed with a static XLA buffer;
+- CONTINUOUS batching: new requests are prefilled into free slots while
+  other slots keep decoding — no batch barrier (Orca-style iteration-level
+  scheduling);
+- prefill is bucketed (prompt padded to the next bucket) so each bucket
+  compiles once; decode is one compiled multi-step program (T tokens per
+  host round trip — hides dispatch latency, critical over tunneled TPUs);
+- per-request metrics: TTFT (first token latency) and decode tok/s, scraped
+  by bench_serve.py for the BASELINE req/s + p50 TTFT headline.
+
+``LLMDeployment`` wraps the engine as a serve deployment; requests are
+dicts {"tokens": [...], "max_tokens": N} -> {"tokens": [...], "ttft_s": ...}.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.llm")
+
+
+@dataclass
+class GenRequest:
+    tokens: List[int]
+    max_tokens: int
+    eos_token: Optional[int]
+    future: Future
+    submitted_at: float = field(default_factory=time.perf_counter)
+    ttft_s: Optional[float] = None
+    out_tokens: List[int] = field(default_factory=list)
+    slot: int = -1
+    pending_first: Any = None  # device scalar: first sampled token, unfetched
+
+
+class LLMEngine:
+    """Continuous-batching loop around models/decode.py."""
+
+    def __init__(self, config, params=None, *, num_slots: int = 8,
+                 max_seq_len: Optional[int] = None, decode_chunk: int = 8,
+                 temperature: float = 0.0, prefill_buckets: Optional[List[int]] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decode import (
+            init_kv_cache,
+            make_decode_fn,
+            make_prefill_fn,
+        )
+        from ray_tpu.models.llama import llama_init
+
+        self.config = config
+        self.num_slots = num_slots
+        self.max_seq = max_seq_len or config.max_seq_len
+        self.decode_chunk = decode_chunk
+        self.params = params if params is not None else llama_init(
+            config, jax.random.key(0)
+        )
+        self.cache = init_kv_cache(config, num_slots, self.max_seq)
+        self.prefill_buckets = sorted({
+            min(b, self.max_seq) for b in (prefill_buckets or [128, 512, 2048])
+        })
+        self._prefill = make_prefill_fn(config)
+        self._decode = make_decode_fn(config, decode_chunk, temperature)
+        self._key = jax.random.key(0)
+        # device-side batch state
+        self._tokens = jnp.zeros((num_slots,), jnp.int32)
+        self._positions = jnp.zeros((num_slots,), jnp.int32)
+        self._active = jnp.zeros((num_slots,), bool)
+        # host-side state
+        self._slots: List[Optional[GenRequest]] = [None] * num_slots
+        self._pending: "queue.Queue[GenRequest]" = queue.Queue()
+        self._shutdown = False
+        self._jnp = jnp
+        self._jax = jax
+        self._steps = 0
+        self._tokens_out = 0
+        self._started = time.perf_counter()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-engine")
+        self._thread.start()
+
+    # ----------------------------------------------------------------- API
+    def generate(self, tokens: List[int], max_tokens: int = 64,
+                 eos_token: Optional[int] = None,
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Blocking generate (replica-thread entry). Returns
+        {"tokens", "ttft_s", "latency_s"}."""
+        if len(tokens) + max_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt {len(tokens)} + max_tokens {max_tokens} exceeds "
+                f"max_seq_len {self.max_seq}"
+            )
+        req = GenRequest(tokens=list(tokens), max_tokens=max_tokens,
+                         eos_token=eos_token, future=Future())
+        self._pending.put(req)
+        result = req.future.result(timeout=timeout)
+        return result
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "slots": self.num_slots,
+            "active": sum(r is not None for r in self._slots),
+            "queued": self._pending.qsize(),
+            "decode_steps": self._steps,
+            "tokens_generated": self._tokens_out,
+            "uptime_s": time.perf_counter() - self._started,
+        }
+
+    def stop(self) -> None:
+        self._shutdown = True
+        # join: a daemon thread still inside a jax dispatch at interpreter
+        # shutdown aborts the process (pthread "exception not rethrown")
+        self._thread.join(timeout=10)
+
+    # ---------------------------------------------------------------- loop
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        # longer than the largest configured bucket: round up to a 128
+        # multiple (one extra compile) rather than silently truncating the
+        # prompt — max_seq admission already guaranteed it fits
+        return min(self.max_seq, -(-n // 128) * 128)
+
+    def _admit(self) -> None:
+        """Prefill waiting requests into free slots WITHOUT a host sync: the
+        first sampled token stays on device and is fetched together with the
+        next decode chunk (one round trip per loop iteration — dispatch
+        latency over tunneled TPUs would otherwise serialize admissions)."""
+        jnp = self._jnp
+        while True:
+            try:
+                free = self._slots.index(None)
+            except ValueError:
+                return
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            n = len(req.tokens)
+            bucket = self._bucket_for(n)
+            assert bucket >= n, (bucket, n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = req.tokens
+            logits, self.cache = self._prefill(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.int32(free), jnp.int32(min(n, bucket)),
+            )
+            first = jnp.argmax(logits).astype(jnp.int32)  # device scalar
+            req.pending_first = first
+            req.slot = free
+            self._slots[free] = req
+            self._tokens = self._tokens.at[free].set(first)
+            self._positions = self._positions.at[free].set(n)
+            self._active = self._active.at[free].set(True)
+
+    def _finished(self, req: GenRequest) -> bool:
+        if len(req.out_tokens) >= req.max_tokens:
+            return True
+        if req.eos_token is not None and req.out_tokens and \
+                req.out_tokens[-1] == req.eos_token:
+            return True
+        if req.slot >= 0 and len(req.tokens) + len(req.out_tokens) >= self.max_seq:
+            return True
+        return False
+
+    def _retire(self, slot: int) -> None:
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._active = self._active.at[slot].set(False)
+        if req is None:
+            return
+        if req.eos_token is not None and req.eos_token in req.out_tokens:
+            req.out_tokens = req.out_tokens[: req.out_tokens.index(req.eos_token) + 1]
+        self._tokens_out += len(req.out_tokens)
+        req.future.set_result({
+            "tokens": req.out_tokens,
+            "ttft_s": req.ttft_s,
+            "latency_s": time.perf_counter() - req.submitted_at,
+        })
+
+    def _loop(self) -> None:
+        jax = self._jax
+        while not self._shutdown:
+            try:
+                self._admit()
+                if not any(r is not None for r in self._slots):
+                    time.sleep(0.01)  # idle: poll for work (_admit drains FIFO)
+                    continue
+                self._key, sub = jax.random.split(self._key)
+                sampled, last, self._positions, self.cache = self._decode(
+                    self.params, self.cache, self._tokens, self._positions,
+                    self._active, sub,
+                )
+                self._tokens = last
+                self._steps += self.decode_chunk
+                # ONE host sync per chunk: chunk tokens + any pending first
+                # tokens from this round's prefills
+                firsts = {slot: req.pending_first
+                          for slot, req in enumerate(self._slots)
+                          if req is not None and req.pending_first is not None}
+                host_tokens, host_firsts = jax.device_get((sampled, firsts))
+                now = time.perf_counter()
+                for slot, first in host_firsts.items():
+                    req = self._slots[slot]
+                    if req is None:
+                        continue
+                    req.pending_first = None
+                    req.ttft_s = now - req.submitted_at
+                    req.out_tokens.append(int(first))
+                for slot, req in enumerate(self._slots):
+                    if req is None:
+                        continue
+                    if self._finished(req):
+                        self._retire(slot)
+                        continue
+                    for t in host_tokens[slot]:
+                        req.out_tokens.append(int(t))
+                        if self._finished(req):
+                            break
+                    if self._finished(req):
+                        self._retire(slot)
+            except Exception:  # noqa: BLE001 - engine loop must survive
+                logger.exception("llm engine loop error")
+                time.sleep(0.5)
+
+
+class LLMDeployment:
+    """Serve deployment wrapping LLMEngine. Construct via serve.deployment:
+
+        app = serve.deployment(LLMDeployment, name="llm").bind(model="tiny")
+        handle = serve.run(app)
+        handle.generate.remote({"tokens": [...], "max_tokens": 32}).result()
+    """
+
+    def __init__(self, model: str = "tiny", num_slots: int = 8,
+                 decode_chunk: int = 8, max_seq_len: Optional[int] = None,
+                 temperature: float = 0.0, params=None):
+        from ray_tpu.models.llama import LlamaConfig
+
+        factories = {
+            "tiny": LlamaConfig.tiny,
+            "llama_1b": LlamaConfig.llama_1b,
+            "llama3_8b": LlamaConfig.llama3_8b,
+        }
+        if model not in factories:
+            raise ValueError(f"unknown model '{model}'; options: {sorted(factories)}")
+        config = factories[model]()
+        self.engine = LLMEngine(
+            config, params, num_slots=num_slots, decode_chunk=decode_chunk,
+            max_seq_len=max_seq_len, temperature=temperature,
+        )
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        return self.generate(request)
+
+    def generate(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        return self.engine.generate(
+            tokens=request["tokens"],
+            max_tokens=int(request.get("max_tokens", 64)),
+            eos_token=request.get("eos_token"),
+            timeout=request.get("timeout"),
+        )
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def __del__(self):
+        try:
+            self.engine.stop()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,171 @@
+"""HTTP proxy: the ingress data plane.
+
+Reference capability: serve/_private/proxy.py (ProxyActor:446, HTTP entry
+:542 — route-prefix matching, request forwarding to replicas via the
+replica scheduler, draining). Here: a minimal asyncio HTTP/1.1 server run by
+a proxy actor (stdlib only — no starlette in the image); bodies are decoded
+by content-type (json -> dict, text -> str, else bytes) and handed to the
+deployment's __call__ through the pow-2 router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.proxy")
+
+
+class ProxyActor:
+    """One per serve instance (head node). Routes /app_name/... -> app."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+        self._controller = controller
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, Any] = {}  # app -> Router (lazy)
+        self._ready = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="serve-http-proxy")
+        self._thread.start()
+        self._ready.wait(timeout=30)
+
+    def address(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def check_health(self) -> bool:
+        return self._ready.is_set()
+
+    # ------------------------------------------------------------- http core
+    def _serve(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def start():
+            server = await asyncio.start_server(self._on_conn, self._host, self._port)
+            self._port = server.sockets[0].getsockname()[1]
+            self._ready.set()
+            async with server:
+                await server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(start())
+        except Exception:  # noqa: BLE001
+            logger.exception("proxy server died")
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                method, path, headers, body = req
+                status, payload, ctype = await self._handle(method, path, headers, body)
+                keep = headers.get("connection", "").lower() != "close"
+                writer.write(
+                    b"HTTP/1.1 " + status + b"\r\n"
+                    b"Content-Type: " + ctype + b"\r\n"
+                    b"Content-Length: " + str(len(payload)).encode() + b"\r\n"
+                    + (b"Connection: keep-alive\r\n" if keep else b"Connection: close\r\n")
+                    + b"\r\n" + payload
+                )
+                await writer.drain()
+                if not keep:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("proxy connection error")
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _read_request(self, reader) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+        line = await reader.readline()
+        if not line:
+            return None
+        parts = line.decode("latin1").strip().split(" ")
+        if len(parts) < 2:
+            return None
+        method, path = parts[0], parts[1]
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            h = h.decode("latin1").strip()
+            if not h:
+                break
+            if ":" in h:
+                k, v = h.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", 0) or 0)
+        body = await reader.readexactly(length) if length else b""
+        return method, path, headers, body
+
+    async def _handle(self, method: str, path: str, headers: Dict[str, str],
+                      body: bytes) -> Tuple[bytes, bytes, bytes]:
+        loop = asyncio.get_event_loop()
+        path = path.split("?", 1)[0]
+        if path in ("/-/healthz", "/-/routes"):
+            if path == "/-/healthz":
+                return b"200 OK", b"ok", b"text/plain"
+            import ray_tpu
+
+            # controller calls block: keep them off the event-loop thread
+            apps = await loop.run_in_executor(
+                None,
+                lambda: ray_tpu.get(self._controller.list_apps.remote(), timeout=10),
+            )
+            return b"200 OK", json.dumps({f"/{a}": a for a in apps}).encode(), b"application/json"
+        segs = [s for s in path.split("/") if s]
+        if not segs:
+            return b"404 Not Found", b"no application in path", b"text/plain"
+        app = segs[0]
+        router = await loop.run_in_executor(None, self._router_for, app)
+        if router is None:
+            return b"404 Not Found", f"no app '{app}'".encode(), b"text/plain"
+        # decode body by content type
+        ctype = headers.get("content-type", "")
+        arg: Any
+        if "json" in ctype and body:
+            try:
+                arg = json.loads(body)
+            except json.JSONDecodeError:
+                return b"400 Bad Request", b"invalid json", b"text/plain"
+        elif body:
+            arg = body.decode() if "text" in ctype else body
+        else:
+            arg = None
+        try:
+            result = await loop.run_in_executor(
+                None, lambda: router.call("__call__", (arg,) if arg is not None else (), {})
+            )
+        except Exception as e:  # noqa: BLE001 - surface as 500
+            return b"500 Internal Server Error", str(e).encode(), b"text/plain"
+        if isinstance(result, bytes):
+            return b"200 OK", result, b"application/octet-stream"
+        if isinstance(result, str):
+            return b"200 OK", result.encode(), b"text/plain"
+        try:
+            return b"200 OK", json.dumps(result).encode(), b"application/json"
+        except TypeError:
+            return b"200 OK", str(result).encode(), b"text/plain"
+
+    def _router_for(self, app: str):
+        import ray_tpu
+        from ray_tpu.serve.router import Router
+
+        r = self._routes.get(app)
+        if r is None:
+            apps = ray_tpu.get(self._controller.list_apps.remote(), timeout=10)
+            if app not in apps:
+                return None
+            r = Router(self._controller, app)
+            self._routes[app] = r
+        return r

@@ -1,0 +1,135 @@
+"""Power-of-two-choices replica router.
+
+Reference capability: serve/_private/replica_scheduler/pow_2_scheduler.py
+(PowerOfTwoChoicesReplicaScheduler:52, select via queue-length probing
+:352). Per-process router: keeps a cached replica set (refreshed from the
+controller), picks two random replicas, routes to the one with the shorter
+cached queue, and retries on overload/death with the stale replica evicted.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.router")
+
+REFRESH_PERIOD_S = 2.0
+
+
+class Router:
+    def __init__(self, controller, app_name: str):
+        self._controller = controller
+        self._app = app_name
+        self._replicas: List[Any] = []
+        self._queue_len: Dict[Any, int] = {}  # cached estimates per handle
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- replica set
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_refresh < REFRESH_PERIOD_S and self._replicas:
+                return
+            self._last_refresh = now
+        try:
+            replicas = ray_tpu.get(
+                self._controller.get_replicas.remote(self._app), timeout=10
+            )
+        except Exception:  # noqa: BLE001 - controller briefly unavailable
+            logger.warning("router: replica refresh failed for %s", self._app)
+            return
+        # probe live queue lengths (corrects drift from fire-and-forget
+        # handle submissions whose completion the router never observes)
+        probes = [(r, r.stats.remote()) for r in replicas]
+        fresh: Dict[Any, int] = {}
+        for r, ref in probes:
+            try:
+                fresh[r] = int(ray_tpu.get(ref, timeout=2)["ongoing"])
+            except Exception:  # noqa: BLE001 - dead/slow replica: keep stale
+                fresh[r] = self._queue_len.get(r, 0)
+        with self._lock:
+            self._replicas = list(replicas)
+            self._queue_len = fresh
+
+    def _pick(self) -> Any:
+        """Pow-2: two random candidates, lower cached queue length wins."""
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            raise exc.RayTpuError("no replicas available")
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        with self._lock:
+            qa = self._queue_len.get(a, 0)
+            qb = self._queue_len.get(b, 0)
+        return a if qa <= qb else b
+
+    def _note(self, replica, delta: int) -> None:
+        with self._lock:
+            if replica in self._queue_len:
+                self._queue_len[replica] = max(0, self._queue_len.get(replica, 0) + delta)
+
+    def _evict(self, replica) -> None:
+        with self._lock:
+            if replica in self._replicas:
+                self._replicas.remove(replica)
+            self._queue_len.pop(replica, None)
+
+    # -------------------------------------------------------------- routing
+    def route(self, method: str, args: tuple, kwargs: dict,
+              max_attempts: int = 10) -> Tuple[Any, Any]:
+        """Submit to a chosen replica; returns (result ObjectRef, replica)."""
+        self._refresh()
+        last: Optional[Exception] = None
+        for _ in range(max_attempts):
+            try:
+                replica = self._pick()
+            except exc.RayTpuError as e:
+                last = e
+                time.sleep(0.2)
+                self._refresh(force=True)
+                continue
+            self._note(replica, +1)
+            ref = replica.handle_request.remote(method, args, kwargs)
+            return ref, replica
+        raise exc.RayTpuError(f"no route for {self._app}.{method}: {last}")
+
+    def call(self, method: str, args: tuple, kwargs: dict, timeout: Optional[float] = None):
+        """Route AND resolve, retrying overloads on other replicas
+        (the synchronous fast path used by the proxy)."""
+        from ray_tpu.serve.replica import ReplicaOverloadedError
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        attempts = 0
+        while True:
+            ref, replica = self.route(method, args, kwargs)
+            try:
+                remaining = None if deadline is None else max(0.1, deadline - time.monotonic())
+                result = ray_tpu.get(ref, timeout=remaining)
+                self._note(replica, -1)
+                return result
+            except Exception as e:  # noqa: BLE001
+                self._note(replica, -1)
+                if isinstance(e, ReplicaOverloadedError) or "ReplicaOverloadedError" in str(type(e).__name__):
+                    attempts += 1
+                    if attempts > 20:
+                        raise
+                    time.sleep(min(0.05 * attempts, 0.5))
+                    continue
+                if isinstance(e, (exc.ActorDiedError, exc.ActorUnavailableError)):
+                    self._evict(replica)
+                    self._refresh(force=True)
+                    attempts += 1
+                    if attempts > 5:
+                        raise
+                    continue
+                raise

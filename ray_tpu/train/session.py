@@ -63,6 +63,12 @@ class TrainContext:
     trial_dir: str
 
 
+class SessionStopped(BaseException):
+    """Raised inside the training thread when the controller stops the
+    session (BaseException so user ``except Exception`` blocks can't swallow
+    it; the stack unwinds through the trainable, releasing gangs/PGs)."""
+
+
 class _Session:
     def __init__(self, ctx: TrainContext, latest_checkpoint: Optional[Checkpoint],
                  dataset_shards: Optional[Dict[str, Any]] = None):
@@ -72,6 +78,7 @@ class _Session:
         self.result_queue: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.continue_event = threading.Event()
         self.finished = False
+        self.stop_requested = False
         self.error: Optional[BaseException] = None
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
@@ -92,6 +99,8 @@ class _Session:
         # lockstep with the trainer's collection round
         self.continue_event.wait()
         self.continue_event.clear()
+        if self.stop_requested:
+            raise SessionStopped()
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.latest_checkpoint

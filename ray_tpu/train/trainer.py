@@ -163,16 +163,35 @@ class TpuTrainer:
         self.datasets = datasets or {}
         self.use_jax_distributed = use_jax_distributed
 
-    def fit(self) -> Result:
+    def fit(self, _tune_session=None, _resume_from: Optional[str] = None) -> Result:
+        """Run the distributed training job.
+
+        Routed through Tune when called without a session (reference:
+        train/base_trainer.py:567 — ``Trainer.fit`` IS a 1-trial Tune run, so
+        failure handling, experiment state, and result plumbing are shared
+        with hyperparameter sweeps). The Tuner's trial actor calls back in
+        with ``_tune_session`` set, which runs the gang directly and streams
+        per-round metrics to the trial."""
+        if _tune_session is None:
+            from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+            tuner = Tuner(
+                self,
+                tune_config=TuneConfig(num_samples=1, max_concurrent_trials=1),
+                run_config=self.run_config,
+            )
+            grid = tuner.fit()
+            return grid[0]
         max_failures = self.run_config.failure_config.max_failures
         trial_dir = self.run_config.resolved_storage_path()
         os.makedirs(trial_dir, exist_ok=True)
-        latest_checkpoint: Optional[str] = None
+        latest_checkpoint: Optional[str] = _resume_from
         history: List[Dict[str, Any]] = []
         failures = 0
         while True:
             try:
-                result = self._run_attempt(trial_dir, latest_checkpoint, history)
+                result = self._run_attempt(trial_dir, latest_checkpoint, history,
+                                           tune_session=_tune_session)
                 return result
             except _AttemptFailed as e:
                 failures += 1
@@ -191,7 +210,7 @@ class TpuTrainer:
 
     # ------------------------------------------------------------------
     def _run_attempt(self, trial_dir: str, latest_checkpoint: Optional[str],
-                     history: List[Dict[str, Any]]) -> Result:
+                     history: List[Dict[str, Any]], tune_session=None) -> Result:
         scaling = self.scaling
         pg = None
         workers: List[Any] = []
@@ -260,6 +279,22 @@ class TpuTrainer:
                 elif any(ckpts):
                     latest_checkpoint = next(c for c in ckpts if c)
                 self._apply_keep_policy(trial_dir)
+                if tune_session is not None:
+                    # stream the round to the owning Tune trial (lockstep,
+                    # same contract as session.report)
+                    tune_session.result_queue.put({
+                        "metrics": dict(rank0["metrics"]),
+                        "checkpoint": latest_checkpoint,
+                        "done": False,
+                    })
+                    tune_session.continue_event.wait()
+                    tune_session.continue_event.clear()
+                    if tune_session.stop_requested:
+                        from ray_tpu.train.session import SessionStopped
+
+                        # unwind through _run_attempt's finally: gang +
+                        # placement group released before the trial stops
+                        raise SessionStopped()
             if final_error is not None:
                 raise _AttemptFailed(final_error, latest_checkpoint)
             return Result(

@@ -1,0 +1,34 @@
+"""ray_tpu.tune — hyperparameter search over the actor runtime.
+
+Reference capability: python/ray/tune (Tuner, search spaces, ASHA/PBT
+schedulers, experiment checkpoint/resume). ``tune.report`` is the same
+session plumbing as ``train.report`` (one trial == one training session),
+so TpuTrainer-based trainables and plain functions share the code path.
+"""
+
+from ray_tpu.train.session import get_checkpoint, report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import choice, grid_search, loguniform, randint, uniform
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "uniform",
+]

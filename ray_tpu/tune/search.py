@@ -1,0 +1,126 @@
+"""Search spaces + samplers.
+
+Reference capability: python/ray/tune/search/ (sample.py Domain classes:
+Categorical/Float/Integer with uniform/loguniform, grid_search markers,
+BasicVariantGenerator grid x random expansion in
+search/basic_variant.py). Spaces are declarative markers resolved per
+trial by ``generate_trial_configs``.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Categorical(Domain):
+    categories: Sequence[Any]
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(list(self.categories))
+
+
+@dataclass
+class Float(Domain):
+    low: float
+    high: float
+    log: bool = False
+
+    def sample(self, rng: random.Random) -> float:
+        if self.log:
+            return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class Integer(Domain):
+    low: int
+    high: int  # exclusive, reference randint semantics
+
+    def sample(self, rng: random.Random) -> int:
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class GridSearch:
+    values: Sequence[Any]
+
+
+# ------------------------------------------------------------------ public api
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(low: float, high: float) -> Float:
+    return Float(low, high)
+
+
+def loguniform(low: float, high: float) -> Float:
+    return Float(low, high, log=True)
+
+
+def randint(low: int, high: int) -> Integer:
+    return Integer(low, high)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+# ---------------------------------------------------------------- resolution
+def _is_grid(v: Any) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _grid_axes(space: Dict[str, Any], prefix: tuple = ()) -> List[tuple]:
+    axes = []
+    for k, v in space.items():
+        if _is_grid(v):
+            axes.append((prefix + (k,), list(v["grid_search"])))
+        elif isinstance(v, dict):
+            axes.extend(_grid_axes(v, prefix + (k,)))
+    return axes
+
+
+def _set_path(d: Dict[str, Any], path: tuple, value: Any) -> None:
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def _resolve(space: Any, rng: random.Random) -> Any:
+    if isinstance(space, Domain):
+        return space.sample(rng)
+    if _is_grid(space):
+        raise ValueError("grid_search resolved separately")
+    if isinstance(space, dict):
+        return {k: _resolve(v, rng) for k, v in space.items() if not _is_grid(v)}
+    return space
+
+
+def generate_trial_configs(param_space: Dict[str, Any], num_samples: int,
+                           seed: int = 0) -> List[Dict[str, Any]]:
+    """Reference semantics (BasicVariantGenerator): the grid is expanded
+    exhaustively and the cartesian product is repeated num_samples times,
+    with non-grid Domains re-sampled per trial."""
+    rng = random.Random(seed)
+    axes = _grid_axes(param_space)
+    grid_points: List[List[tuple]] = [[]]
+    for path, values in axes:
+        grid_points = [g + [(path, v)] for g in grid_points for v in values]
+    configs = []
+    for _ in range(max(1, num_samples)):
+        for point in grid_points:
+            cfg = _resolve(param_space, rng)
+            for path, v in point:
+                _set_path(cfg, path, v)
+            configs.append(cfg)
+    return configs

@@ -1,0 +1,178 @@
+"""Tune tests (reference analogues: python/ray/tune/tests/test_tune_restore.py,
+test_trial_scheduler.py, tune/examples)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, PopulationBasedTraining, TuneConfig, Tuner
+from ray_tpu.train import Checkpoint, RunConfig
+
+
+def _quadratic(config):
+    """Converges toward the minimum of (x - 3)^2; reports 8 iterations."""
+    x = config["x"]
+    for i in range(8):
+        loss = (x - 3.0) ** 2 + 1.0 / (i + 1)
+        tune.report({"loss": loss, "x": x})
+
+
+def test_grid_and_random_search(ray_tpu_local, tmp_path):
+    tuner = Tuner(
+        _quadratic,
+        param_space={"x": tune.grid_search([0.0, 3.0, 6.0])},
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=2,
+                               max_concurrent_trials=3),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 6  # 3 grid points x 2 samples
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics["x"] == 3.0
+    assert not grid.errors
+
+
+def test_search_space_sampling():
+    from ray_tpu.tune.search import generate_trial_configs
+
+    cfgs = generate_trial_configs(
+        {"lr": tune.loguniform(1e-5, 1e-1), "layers": tune.randint(1, 4),
+         "act": tune.choice(["relu", "gelu"]),
+         "bs": tune.grid_search([8, 16])},
+        num_samples=3, seed=42,
+    )
+    assert len(cfgs) == 6
+    for c in cfgs:
+        assert 1e-5 <= c["lr"] <= 1e-1
+        assert c["layers"] in (1, 2, 3)
+        assert c["act"] in ("relu", "gelu")
+        assert c["bs"] in (8, 16)
+    assert {c["bs"] for c in cfgs} == {8, 16}
+
+
+def test_asha_stops_bad_trials(ray_tpu_local, tmp_path):
+    def trainable(config):
+        for i in range(1, 17):
+            # bad trials plateau high; good trials descend
+            loss = config["quality"] * 10.0 + 1.0 / i
+            tune.report({"loss": loss})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([0, 1, 2, 3, 4, 5, 6, 7])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=4,
+            scheduler=ASHAScheduler(metric="loss", mode="min", grace_period=2,
+                                    reduction_factor=2, max_t=16),
+        ),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    trials = tuner_trials = grid._trials
+    stopped = [t for t in trials if t.status == "STOPPED"]
+    finished = [t for t in trials if t.status == "TERMINATED"]
+    assert stopped, "ASHA never early-stopped anything"
+    assert finished, "ASHA stopped everything"
+    # the best trial must have survived
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics["loss"] < 1.0
+
+
+def test_checkpoint_and_resume(ray_tpu_local, tmp_path):
+    def trainable(config):
+        import json
+        import tempfile
+
+        ckpt = tune.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.to_directory(), "state.json")) as f:
+                start = json.load(f)["iter"] + 1
+        for i in range(start, 4):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"iter": i}, f)
+            tune.report({"loss": 1.0 / (i + 1), "step": i},
+                        checkpoint=Checkpoint(d))
+
+    tuner = Tuner(
+        trainable, param_space={},
+        tune_config=TuneConfig(num_samples=2, max_concurrent_trials=2),
+        run_config=RunConfig(name="ckpt", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    exp_dir = os.path.join(str(tmp_path), "ckpt")
+    assert os.path.exists(os.path.join(exp_dir, "experiment_state.json"))
+    for r in grid:
+        assert r.checkpoint is not None
+        assert r.metrics["step"] == 3
+
+    # resume: completed trials are not re-run (their results are retained)
+    tuner2 = Tuner.restore(exp_dir, trainable)
+    grid2 = tuner2.fit()
+    assert len(grid2) == 2
+    for r in grid2:
+        assert r.metrics["step"] == 3
+
+
+def test_pbt_exploits(ray_tpu_local, tmp_path):
+    def trainable(config):
+        import json
+        import tempfile
+
+        ckpt = tune.get_checkpoint()
+        score = 0.0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.to_directory(), "s.json")) as f:
+                score = json.load(f)["score"]
+        for i in range(1, 13):
+            score += config["rate"]
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump({"score": score}, f)
+            tune.report({"score": score}, checkpoint=Checkpoint(d))
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"rate": tune.uniform(0.1, 2.0)},
+    )
+    tuner = Tuner(
+        trainable,
+        param_space={"rate": tune.grid_search([0.1, 0.2, 1.5, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               max_concurrent_trials=4, scheduler=pbt),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    # every trial should end with a decent score: laggards exploited leaders
+    scores = sorted(r.metrics.get("score", 0.0) for r in grid)
+    assert scores[0] > 0.1 * 12 * 0.9, scores  # worst trial improved over pure 0.1-rate
+
+
+def test_trainer_fit_routes_through_tune(ray_tpu_local, tmp_path):
+    """TpuTrainer.fit == 1-trial Tune run (reference base_trainer.py:567)."""
+    from ray_tpu.train import ScalingConfig, TpuTrainer
+    from ray_tpu import train
+
+    def loop(config):
+        for i in range(3):
+            train.report({"loss": 10.0 - i, "lr": config["lr"]})
+
+    trainer = TpuTrainer(
+        loop,
+        train_loop_config={"lr": 0.5},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="fit_tune", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] == 8.0
+    assert result.metrics["lr"] == 0.5
+    assert len(result.metrics_history) == 3
+    # the tune experiment state exists on disk
+    assert os.path.exists(os.path.join(str(tmp_path), "fit_tune",
+                                       "experiment_state.json"))
