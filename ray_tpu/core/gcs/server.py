@@ -619,6 +619,7 @@ class GcsServer:
         )
         rec["size"] = size
         rec["locations"].add(node_id)
+        rec["had_locations"] = True
         await self.rpc.publish(f"objects:{object_id}", {"size": size, "node_id": node_id})
         return True
 
@@ -632,7 +633,15 @@ class GcsServer:
         rec = self.objects.get(object_id)
         if rec is None:
             return None
-        return {"size": rec["size"], "locations": sorted(rec["locations"]), "owner": rec["owner"]}
+        return {
+            "size": rec["size"],
+            "locations": sorted(rec["locations"]),
+            "owner": rec["owner"],
+            # lost = every copy was on since-dead nodes: the value is gone and
+            # only lineage reconstruction (owner resubmits the producing task)
+            # can bring it back — waiting won't (object_recovery_manager.h:41)
+            "lost": not rec["locations"] and rec.get("had_locations", False),
+        }
 
     async def rpc_free_object(self, object_id: str) -> List[str]:
         rec = self.objects.pop(object_id, None)
