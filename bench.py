@@ -59,7 +59,7 @@ def main() -> None:
 
     if on_tpu:
         config = LlamaConfig.llama_1b(
-            max_seq_len=2048, remat="nothing_saveable", attention_impl="flash"
+            max_seq_len=2048, remat="save_attn", attention_impl="flash"
         )
         batch, seq, steps, warmup = 8, 2048, 20, 3
     else:

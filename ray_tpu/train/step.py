@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ray_tpu.models.llama import LlamaConfig, cross_entropy_loss, llama_forward, llama_init, llama_logical_axes
+from ray_tpu.models.llama import LlamaConfig, cross_entropy_loss, llama_forward, llama_init, llama_logical_axes, llama_loss
 from ray_tpu.parallel.sharding import (
     DEFAULT_LLM_RULES,
     ShardingRules,
@@ -137,8 +137,7 @@ def make_train_step(
 
     def step_fn(state: TrainState, tokens, targets) -> Tuple[TrainState, Dict[str, jax.Array]]:
         def loss_fn(params):
-            logits = llama_forward(params, tokens, config, mesh=mesh, rules=rules)
-            return cross_entropy_loss(logits, targets)
+            return llama_loss(params, tokens, targets, config, mesh=mesh, rules=rules)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)

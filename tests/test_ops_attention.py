@@ -22,6 +22,23 @@ CASES = [
     (1, 200, 2, 2, 64, True),  # non-multiple of block -> pad path
 ]
 
+# seqs that are NOT multiples of the (asymmetric) default blocks: the pad
+# logic must find a COMMON q/k padding so these stay on the flash kernel
+# (regression: minimal per-side padding used to kick them to the reference).
+RAGGED_CASES = [(768, 256, 512), (640, 256, 512), (1100, 256, 512)]
+
+
+@pytest.mark.parametrize("s,bq,bk", RAGGED_CASES)
+def test_flash_common_padding_ragged_seq(s, bq, bk):
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((1, s, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, s, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, s, 2, 32)), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        ref = reference_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True, block_q=bq, block_k=bk)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
 
 @pytest.mark.parametrize("b,sq,hq,hkv,d,causal", CASES)
 def test_flash_matches_reference(b, sq, hq, hkv, d, causal):

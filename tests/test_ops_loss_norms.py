@@ -1,0 +1,107 @@
+"""Fused cross-entropy + rms_norm custom-VJP correctness vs plain autodiff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.llama import LlamaConfig, cross_entropy_loss, llama_forward, llama_init, llama_loss
+from ray_tpu.ops.loss import fused_cross_entropy
+from ray_tpu.ops.norms import rms_norm
+
+
+def _ref_ce(x, head, t, mask=None):
+    logits = (x @ head).astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, -1)
+    gold = jnp.take_along_axis(logits, t[..., None], -1)[..., 0]
+    nll = logz - gold
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
+
+
+@pytest.mark.parametrize("with_mask", [False, True])
+def test_fused_ce_matches_reference(with_mask):
+    rng = np.random.default_rng(0)
+    B, S, H, V = 2, 16, 8, 11
+    x = jnp.asarray(rng.standard_normal((B, S, H)), jnp.float32)
+    head = jnp.asarray(rng.standard_normal((H, V)), jnp.float32)
+    t = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+    mask = jnp.asarray(rng.integers(0, 2, (B, S)), jnp.float32) if with_mask else None
+
+    l1 = fused_cross_entropy(x, head, t, mask, 4)
+    l2 = _ref_ce(x, head, t, mask)
+    assert abs(float(l1) - float(l2)) < 1e-5
+
+    g1 = jax.grad(lambda x, h: fused_cross_entropy(x, h, t, mask, 4), argnums=(0, 1))(x, head)
+    g2 = jax.grad(lambda x, h: _ref_ce(x, h, t, mask), argnums=(0, 1))(x, head)
+    np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]), atol=1e-5)
+
+
+def test_fused_ce_ragged_seq_uses_largest_divisor_chunking():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 15, 8)), jnp.float32)
+    head = jnp.asarray(rng.standard_normal((8, 11)), jnp.float32)
+    t = jnp.asarray(rng.integers(0, 11, (2, 15)), jnp.int32)
+    l1 = fused_cross_entropy(x, head, t, None, 4)  # 15 % 4 != 0
+    l2 = _ref_ce(x, head, t)
+    assert abs(float(l1) - float(l2)) < 1e-5
+
+
+def test_rms_norm_custom_vjp_matches_autodiff():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((4, 16, 32)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((32,)), jnp.float32)
+
+    def ref(x, w, eps=1e-6):
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, -1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+    np.testing.assert_allclose(np.asarray(rms_norm(x, w)), np.asarray(ref(x, w)), atol=1e-6)
+    g1 = jax.grad(lambda x, w: (rms_norm(x, w) ** 2).sum(), argnums=(0, 1))(x, w)
+    g2 = jax.grad(lambda x, w: (ref(x, w) ** 2).sum(), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]), atol=1e-5)
+
+
+@pytest.mark.parametrize("remat,impl", [
+    (None, "reference"),
+    ("full", "reference"),
+    ("nothing_saveable", "reference"),
+    ("mlp_only", "reference"),
+    # save_attn must run the flash custom-VJP path: its policy keys on the
+    # checkpoint_name tags emitted inside _flash_attention_fwd, which the
+    # reference impl never produces (the policy would be vacuous there).
+    ("save_attn", "flash_interpret"),
+])
+def test_remat_modes_same_loss_and_grads(remat, impl):
+    """Every remat policy must be a pure memory/compute tradeoff — identical
+    loss and gradients to no-remat."""
+    base = LlamaConfig.tiny(dtype=jnp.float32, remat=None, attention_impl=impl)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=remat, attention_impl=impl)
+    params = llama_init(base, jax.random.key(0))
+    tokens = jnp.asarray(np.random.default_rng(3).integers(0, 256, (2, 32)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def loss(p, c):
+        return llama_loss(p, tokens, targets, c)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(p, base))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(p, cfg))(params)
+    assert abs(float(l0) - float(l1)) < 1e-5
+    flat0 = jax.tree.leaves(g0)
+    flat1 = jax.tree.leaves(g1)
+    for a, b in zip(flat0, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+
+def test_llama_loss_matches_forward_plus_ce():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=None, attention_impl="reference")
+    params = llama_init(cfg, jax.random.key(1))
+    tokens = jnp.asarray(np.random.default_rng(4).integers(0, 256, (2, 64)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    l1 = llama_loss(params, tokens, targets, cfg)
+    l2 = cross_entropy_loss(llama_forward(params, tokens, cfg), targets)
+    assert abs(float(l1) - float(l2)) < 1e-5

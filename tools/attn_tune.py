@@ -1,0 +1,72 @@
+"""Tune flash attention: compare our kernel at different block sizes and
+dimension_semantics vs the jax.experimental pallas reference kernel."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _scalarize(x):
+    return jnp.sum(x.astype(jnp.float32).ravel()[:16])
+
+
+def timeit(fn, *args, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.device_get(_scalarize(jax.tree.leaves(out)[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.device_get(_scalarize(jax.tree.leaves(out)[0]))
+    return (time.perf_counter() - t0) / steps
+
+
+b, s, hq, hkv, d = 8, 2048, 16, 4, 128
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.standard_normal((b, s, hq, d)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
+fwd_flops = 2 * 2 * b * hq * s * s * d / 2
+bwd_flops = fwd_flops * 2.5
+
+
+def report(name, dt, flops):
+    print(f"{name}: {dt*1e3:6.1f} ms -> {flops/dt/1e12:6.1f} TF/s ({flops/dt/197e12*100:4.1f}%)")
+
+
+# --- jax reference pallas kernel (needs [b, h, s, d]; no GQA -> repeat kv) ---
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    flash_attention as jax_flash, BlockSizes,
+)
+
+qt = q.transpose(0, 2, 1, 3)
+kt = jnp.repeat(k, hq // hkv, axis=2).transpose(0, 2, 1, 3)
+vt = jnp.repeat(v, hq // hkv, axis=2).transpose(0, 2, 1, 3)
+
+bs = BlockSizes(
+    block_q=512, block_k_major=512, block_k=512, block_b=1,
+    block_q_major_dkv=512, block_k_major_dkv=512, block_k_dkv=512, block_q_dkv=512,
+    block_k_major_dq=512, block_k_dq=512, block_q_dq=512,
+)
+f = jax.jit(lambda q, k, v: jax_flash(q, k, v, causal=True, block_sizes=bs))
+report("jax-flash fwd  (512)", timeit(f, qt, kt, vt), fwd_flops)
+
+g = jax.jit(jax.grad(lambda q, k, v: jax_flash(q, k, v, causal=True, block_sizes=bs).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+report("jax-flash f+bwd(512)", timeit(g, qt, kt, vt), fwd_flops + bwd_flops)
+
+# --- ours at various blocks ---
+from ray_tpu.ops.attention import flash_attention as our_flash
+
+for bq, bk in [(128, 128), (256, 512), (512, 512), (512, 1024), (1024, 1024)]:
+    f = jax.jit(lambda q, k, v, bq=bq, bk=bk: our_flash(q, k, v, causal=True, block_q=bq, block_k=bk))
+    report(f"ours fwd   ({bq},{bk})", timeit(f, q, k, v), fwd_flops)
+    g = jax.jit(jax.grad(
+        lambda q, k, v, bq=bq, bk=bk: our_flash(q, k, v, causal=True, block_q=bq, block_k=bk).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    report(f"ours f+bwd ({bq},{bk})", timeit(g, q, k, v), fwd_flops + bwd_flops)
