@@ -72,6 +72,18 @@ class ClusterRuntime(CoreRuntime):
         self.namespace = namespace
         self.gcs = SyncRpcClient(gcs_address)
         self.agent = SyncRpcClient(agent_address)
+        # distributed-GC identity of THIS process + batched ref sync (adds and
+        # removes flushed in submission order so an add never overtakes the
+        # del of the same id)
+        import uuid as _uuid
+
+        self.client_id = f"w:{_uuid.uuid4().hex[:16]}"
+        self._ref_ops: List[Tuple[str, str]] = []  # ("add"|"del", oid hex)
+        self._ref_lock = threading.Lock()       # guards the op queue
+        self._flush_lock = threading.Lock()     # serializes drain+send ordering
+        self._ref_flusher: Optional[threading.Thread] = None
+        self._ref_stop = threading.Event()
+        self._last_holder_hb = 0.0
         self._exported_fns: set = set()
         self._actor_clients: Dict[str, SyncRpcClient] = {}
         self._actor_cache: Dict[str, Dict[str, Any]] = {}
@@ -85,12 +97,16 @@ class ClusterRuntime(CoreRuntime):
     def put(self, value: Any) -> ObjectRef:
         w = global_worker()
         oid = w.next_put_id()
-        payload, _refs = serialization.pack(value)
+        payload, refs = serialization.pack(value)
+        self._queue_ref_op("add", oid.hex())  # this process holds the new ref
         self.agent.call("create_object", object_id=oid.hex(), size=len(payload))
         writer = ShmWriter(oid, len(payload), self.node_hex)
         writer.buffer[:] = payload
         writer.seal()
-        self.agent.call("seal_object", object_id=oid.hex(), size=len(payload))
+        self.agent.call(
+            "seal_object", object_id=oid.hex(), size=len(payload),
+            contained=[r.id.hex() for r in refs] or None,
+        )
         return ObjectRef(oid)
 
     def _read_local(self, oid: ObjectID, size: int, is_error: bool) -> Any:
@@ -170,11 +186,65 @@ class ClusterRuntime(CoreRuntime):
     def free(self, refs: Sequence[ObjectRef]) -> None:
         self.agent.call("free_objects", object_ids=[r.id.hex() for r in refs])
 
+    # ------------------------------------------------- distributed ref counts
+    def _queue_ref_op(self, op: str, oid_hex: str) -> None:
+        with self._ref_lock:
+            self._ref_ops.append((op, oid_hex))
+            if self._ref_flusher is None:
+                self._ref_flusher = threading.Thread(
+                    target=self._ref_flush_loop, daemon=True,
+                    name=f"ref-sync-{self.client_id[2:10]}",
+                )
+                self._ref_flusher.start()
+
+    def _ref_flush_loop(self) -> None:
+        while not self._ref_stop.wait(config.ref_sync_interval_s):
+            try:
+                self.flush_refs()
+                # renew the holder lease so a crashed process (no shutdown,
+                # no heartbeats) gets its holders reaped by the GCS
+                now = time.monotonic()
+                if now - self._last_holder_hb > min(2.5, config.object_holder_lease_s / 4):
+                    self._last_holder_hb = now
+                    self.gcs.call("holder_heartbeat", holder=self.client_id)
+            except Exception:  # noqa: BLE001 - sync is advisory; retry next tick
+                pass
+
+    def flush_refs(self) -> None:
+        """Drain queued add/del holder updates to the GCS, preserving order.
+        Workers call this before completing a task so borrows registered
+        during execution land while the task pin still protects them.
+        The flush lock spans drain+send: two threads draining and sending
+        unserialized could land an add before the del it followed."""
+        with self._flush_lock:
+            with self._ref_lock:
+                ops, self._ref_ops = self._ref_ops, []
+            if not ops:
+                return
+            # coalesce consecutive same-op runs into batched RPCs, keeping order
+            i = 0
+            while i < len(ops):
+                op = ops[i][0]
+                j = i
+                while j < len(ops) and ops[j][0] == op:
+                    j += 1
+                ids = [o for _, o in ops[i:j]]
+                self.gcs.call(
+                    "add_object_refs" if op == "add" else "remove_object_refs",
+                    object_ids=ids, holder=self.client_id,
+                )
+                i = j
+
+    def on_borrowed_ref(self, ref: ObjectRef) -> None:
+        """Deserializer hook: an ObjectRef materialized out of another object
+        — register this process as a holder (reference_count.h borrow)."""
+        self._queue_ref_op("add", ref.id.hex())
+
     def release(self, oid: ObjectID) -> None:
-        # Cluster-wide auto-free on zero local refcount is deliberately OFF in
-        # this tier (no distributed borrow tracking yet); eviction is handled
-        # by the store's LRU+spill and explicit free().
-        pass
+        """Local refcount hit zero: withdraw this process's cluster holder.
+        The GCS frees the object everywhere once ALL holders (other
+        processes, in-flight task pins) are gone plus a grace window."""
+        self._queue_ref_op("del", oid.hex())
 
     # --------------------------------------------------------------- tasks
     def _export_function(self, function_id: str, fn: Any) -> None:
@@ -202,6 +272,9 @@ class ClusterRuntime(CoreRuntime):
     def submit_task(self, spec: TaskSpec, func: Any, args: tuple, kwargs: dict) -> List[ObjectRef]:
         self._export_function(spec.function.function_id, func)
         sd = self._spec_dict(spec, args, kwargs)
+        # the agent registers this holder on the returns (and pins deps under
+        # a task holder) BEFORE accepting — see agent.rpc_submit_task
+        sd["holder"] = self.client_id
         self.agent.call("submit_task", spec=sd)
         return [ObjectRef(oid) for oid in spec.return_ids()]
 
@@ -268,6 +341,19 @@ class ClusterRuntime(CoreRuntime):
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec, args, kwargs) -> List[ObjectRef]:
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         sd = self._spec_dict(spec, args, kwargs)
+        # pin deps+returns for the in-flight call (released when the push
+        # completes in _push_actor_task) and register this process's holder on
+        # the returns — synchronously, while the caller's arg refs are live.
+        # Client-scoped pin id: reaped with this process's holder lease if we
+        # crash before removal.
+        sd["task_holder"] = f"task:{sd['task_id']}@{self.client_id}"
+        try:
+            self.gcs.call(
+                "pin_task", task_holder=sd["task_holder"], deps=sd["deps"],
+                returns=sd["returns"], submitter=self.client_id, spec=None,
+            )
+        except Exception:  # noqa: BLE001 - advisory bookkeeping
+            logger.exception("actor-task ref pinning failed")
         sd.update(actor_id=actor_id.hex(), method=spec.actor_method_name)
         rec = self._actor_cache.get(actor_id.hex())
         if rec is None:
@@ -315,6 +401,21 @@ class ClusterRuntime(CoreRuntime):
             return disp
 
     def _push_actor_task(self, actor_hex: str, sd: Dict[str, Any], max_task_retries: int) -> None:
+        try:
+            self._push_actor_task_inner(actor_hex, sd, max_task_retries)
+        finally:
+            holder = sd.get("task_holder")
+            if holder:
+                try:
+                    self.gcs.call(
+                        "remove_object_refs",
+                        object_ids=(sd.get("deps") or []) + (sd.get("returns") or []),
+                        holder=holder,
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _push_actor_task_inner(self, actor_hex: str, sd: Dict[str, Any], max_task_retries: int) -> None:
         attempts = 0
         while True:
             try:
@@ -424,6 +525,12 @@ class ClusterRuntime(CoreRuntime):
         return self.gcs.call("available_resources")
 
     def shutdown(self) -> None:
+        self._ref_stop.set()
+        try:
+            self.flush_refs()
+            self.gcs.call("drop_holder", holder=self.client_id)
+        except Exception:  # noqa: BLE001
+            pass
         for client in list(self._actor_clients.values()) + list(self._agent_clients.values()):
             if client is not self.agent:
                 client.close()

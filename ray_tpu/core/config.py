@@ -108,6 +108,18 @@ _DEFINITIONS = [
      "Chunk size for node-to-node object transfer."),
     ("object_transfer_retries", 5, int,
      "Pull retries (exponential backoff) before an object fetch errors."),
+    ("object_ref_grace_s", 2.0, float,
+     "Grace window after an object's cluster-wide holder set empties before "
+     "the GCS frees it everywhere (absorbs in-flight ref handoffs)."),
+    ("ref_sync_interval_s", 0.05, float,
+     "Flush interval for the client-side batched object-ref add/remove sync."),
+    ("object_holder_lease_s", 30.0, float,
+     "Process holders (w:*) that miss heartbeats for this long are dropped "
+     "(crashed driver/worker cleanup); task pins are dropped with their node."),
+    ("max_object_reconstructions", 3, int,
+     "Per-object cap on lineage-reconstruction attempts after all copies are lost."),
+    ("max_lineage_bytes", 8 * 1024 * 1024, int,
+     "Task specs above this size are not retained for lineage reconstruction."),
     # --- scheduling ---
     ("scheduler_spread_threshold", 0.5, float,
      "Hybrid policy: pack onto nodes below this utilization, then spread."),

@@ -60,6 +60,26 @@ class GcsServer:
         self._health_task: Optional[asyncio.Task] = None
         self._external: Optional["ExternalPolicyClient"] = None
         self._started_at = time.time()
+        # ---- distributed reference counting (reference_count.h:64 analogue,
+        # GCS-mediated instead of owner-worker-mediated): object hex ->
+        # holder ids ("w:<client>" processes, "task:<id>" in-flight pins).
+        # Objects WITHOUT a holder entry are untracked (never auto-freed).
+        self.object_holders: Dict[str, Set[str]] = {}
+        # holders-empty timestamps: freed by _gc_loop after a grace window so
+        # in-flight ref handoffs (borrow registered after the sender's drop)
+        # don't free the object mid-transfer.
+        self._pending_free: Dict[str, float] = {}
+        # lineage (task_manager.h:208 analogue): return object hex -> the
+        # producing task's spec, for reconstruction after all copies are lost.
+        self.lineage: Dict[str, Dict[str, Any]] = {}
+        # containment edges: object hex -> ids of ObjectRefs serialized inside
+        # it. The container acts as holder ("obj:<hex>") of its children until
+        # it is freed (owner-side "contained refs" in reference_count.h).
+        self.object_contains: Dict[str, List[str]] = {}
+        # w:* process holders renew a lease via heartbeat; silence beyond
+        # object_holder_lease_s = crashed process, drop its holders.
+        self.holder_last_seen: Dict[str, float] = {}
+        self._gc_task: Optional[asyncio.Task] = None
 
     async def start(self) -> Tuple[str, int]:
         host, port = await self.rpc.start()
@@ -69,12 +89,15 @@ class GcsServer:
             self._external = ExternalPolicyClient(config.external_scheduler_address)
             await self._external.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
+        self._gc_task = asyncio.ensure_future(self._gc_loop())
         logger.info("GCS listening on %s:%d", host, port)
         return host, port
 
     async def stop(self) -> None:
         if self._health_task:
             self._health_task.cancel()
+        if self._gc_task:
+            self._gc_task.cancel()
         if self._external:
             await self._external.stop()
         await self.rpc.stop()
@@ -162,6 +185,8 @@ class GcsServer:
         # drop object locations on that node
         for rec in self.objects.values():
             rec["locations"].discard(node_id)
+        # task pins owned by the dead node's agent would never be removed
+        self._drop_node_task_pins(node_id)
         # fail over actors
         for actor_id, rec in list(self.actors.items()):
             if rec.get("node_id") == node_id and rec["state"] == "ALIVE":
@@ -612,7 +637,8 @@ class GcsServer:
 
     # ---------------------------------------------------------------- objects
     async def rpc_register_object(
-        self, object_id: str, size: int, node_id: str, owner: str = ""
+        self, object_id: str, size: int, node_id: str, owner: str = "",
+        contained: Optional[List[str]] = None,
     ) -> bool:
         rec = self.objects.setdefault(
             object_id, {"size": size, "locations": set(), "owner": owner}
@@ -620,6 +646,12 @@ class GcsServer:
         rec["size"] = size
         rec["locations"].add(node_id)
         rec["had_locations"] = True
+        if contained:
+            # ObjectRefs serialized INSIDE this object: the container holds
+            # them until it is freed, so `return ray.put(x)` style nesting
+            # survives the inner creator's process dropping its own refs
+            self.object_contains[object_id] = list(contained)
+            await self.rpc_add_object_refs(contained, f"obj:{object_id}")
         await self.rpc.publish(f"objects:{object_id}", {"size": size, "node_id": node_id})
         return True
 
@@ -645,7 +677,151 @@ class GcsServer:
 
     async def rpc_free_object(self, object_id: str) -> List[str]:
         rec = self.objects.pop(object_id, None)
+        self.object_holders.pop(object_id, None)
+        self._pending_free.pop(object_id, None)
+        self.lineage.pop(object_id, None)
+        contained = self.object_contains.pop(object_id, [])
+        if contained:
+            await self.rpc_remove_object_refs(contained, f"obj:{object_id}")
         return sorted(rec["locations"]) if rec else []
+
+    # ------------------------------------------- distributed reference counts
+    async def rpc_add_object_refs(self, object_ids: List[str], holder: str) -> bool:
+        if holder.startswith("w:"):
+            self.holder_last_seen[holder] = time.monotonic()
+        for object_id in object_ids:
+            self.object_holders.setdefault(object_id, set()).add(holder)
+            self._pending_free.pop(object_id, None)
+        return True
+
+    async def rpc_pin_task(
+        self,
+        task_holder: str,
+        deps: List[str],
+        returns: List[str],
+        submitter: str = "",
+        spec: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """One-shot task-submission bookkeeping (single RPC on the submit hot
+        path): pin deps+returns under the task holder, register the
+        submitter's holder on the returns, retain the spec as lineage."""
+        await self.rpc_add_object_refs(deps + returns, task_holder)
+        if submitter:
+            await self.rpc_add_object_refs(returns, submitter)
+        if spec is not None:
+            for object_id in returns:
+                self.lineage[object_id] = spec
+        return True
+
+    async def rpc_holder_heartbeat(self, holder: str) -> bool:
+        self.holder_last_seen[holder] = time.monotonic()
+        return True
+
+    async def rpc_remove_object_refs(self, object_ids: List[str], holder: str) -> bool:
+        now = time.monotonic()
+        for object_id in object_ids:
+            holders = self.object_holders.get(object_id)
+            if holders is None:
+                continue  # untracked object: explicit free()/LRU only
+            holders.discard(holder)
+            if not holders:
+                self._pending_free[object_id] = now
+        return True
+
+    async def rpc_drop_holder(self, holder: str) -> int:
+        """Remove a holder from every object (dead worker / departing driver).
+        Returns how many objects it was dropped from."""
+        n = 0
+        now = time.monotonic()
+        for object_id, holders in self.object_holders.items():
+            if holder in holders:
+                holders.discard(holder)
+                n += 1
+                if not holders:
+                    self._pending_free[object_id] = now
+        return n
+
+    async def rpc_object_ref_counts(self, object_ids: List[str]) -> Dict[str, int]:
+        return {o: len(self.object_holders.get(o, ())) for o in object_ids}
+
+    async def _gc_loop(self) -> None:
+        """Free objects whose cluster-wide holder set has been empty for a
+        full grace window (the window absorbs in-flight ref handoffs: a
+        receiver registering its borrow after the sender already dropped).
+        Also reaps holders of crashed processes: w:* holders past their
+        heartbeat lease, and task:*@node pins whose node is dead."""
+        while True:
+            await asyncio.sleep(min(0.25, config.object_ref_grace_s / 4))
+            self._reap_stale_holders()
+            if not self._pending_free:
+                continue
+            cutoff = time.monotonic() - config.object_ref_grace_s
+            expired = [o for o, t in self._pending_free.items() if t <= cutoff]
+            for object_id in expired:
+                if self.object_holders.get(object_id):
+                    self._pending_free.pop(object_id, None)
+                    continue  # a holder came back during the grace window
+                await self._free_everywhere(object_id)
+
+    def _reap_stale_holders(self) -> None:
+        now = time.monotonic()
+        lease = config.object_holder_lease_s
+        stale = {
+            h for h, seen in self.holder_last_seen.items() if now - seen > lease
+        }
+        if not stale:
+            return
+        for holder in stale:
+            self.holder_last_seen.pop(holder, None)
+            logger.info("reaping stale holder %s (missed lease)", holder[:24])
+        # a dead process's in-flight task pins (task:<id>@w:<client>) die too
+        dead_suffixes = tuple(f"@{h}" for h in stale)
+        for object_id, holders in self.object_holders.items():
+            doomed = holders & stale
+            doomed |= {h for h in holders
+                       if h.startswith("task:") and h.endswith(dead_suffixes)}
+            if doomed:
+                holders -= doomed
+                if not holders:
+                    self._pending_free[object_id] = now
+
+    def _drop_node_task_pins(self, node_id: str) -> None:
+        """Task pins are namespaced task:<id>@<node>; the owning agent removes
+        them on completion — unless the whole node died first."""
+        suffix = f"@{node_id}"
+        now = time.monotonic()
+        for object_id, holders in self.object_holders.items():
+            dead = {h for h in holders if h.startswith("task:") and h.endswith(suffix)}
+            if dead:
+                holders -= dead
+                if not holders:
+                    self._pending_free[object_id] = now
+
+    async def _free_everywhere(self, object_id: str) -> None:
+        rec = self.objects.pop(object_id, None)
+        self.object_holders.pop(object_id, None)
+        self._pending_free.pop(object_id, None)
+        self.lineage.pop(object_id, None)
+        # the container's grip on its children dies with it (cascade)
+        contained = self.object_contains.pop(object_id, [])
+        if contained:
+            await self.rpc_remove_object_refs(contained, f"obj:{object_id}")
+        for node_id in sorted(rec["locations"]) if rec else []:
+            client = await self._agent_client(node_id)
+            if client is not None:
+                try:
+                    await client.call("delete_local_object", object_id=object_id)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # ------------------------------------------------------------------ lineage
+    async def rpc_put_lineage(self, object_ids: List[str], spec: Dict[str, Any]) -> bool:
+        for object_id in object_ids:
+            self.lineage[object_id] = spec
+        return True
+
+    async def rpc_get_lineage(self, object_id: str) -> Optional[Dict[str, Any]]:
+        return self.lineage.get(object_id)
 
     # ------------------------------------------------------------------ debug
     async def rpc_debug_state(self) -> Dict[str, Any]:
@@ -653,6 +829,9 @@ class GcsServer:
             "nodes": len([n for n in self.nodes.values() if n["Alive"]]),
             "actors": len(self.actors),
             "objects": len(self.objects),
+            "tracked_refs": len(self.object_holders),
+            "pending_free": len(self._pending_free),
+            "lineage_entries": len(self.lineage),
             "pgs": len(self.pgs),
             "kv_keys": len(self.kv),
             "uptime_s": time.time() - self._started_at,

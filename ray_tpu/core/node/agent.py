@@ -45,6 +45,7 @@ class _WorkerHandle:
         self.client: Optional[RpcClient] = None
         self.state = "STARTING"  # STARTING | IDLE | LEASED | ACTOR | DEAD
         self.actor_id: Optional[str] = None
+        self.client_holder: Optional[str] = None  # GCS ref-holder id of the process
         self.ready = asyncio.Event()
         self.lease_token: Optional[Tuple[str, Any, Dict[str, float]]] = None
         self._actor_token: Optional[Tuple[str, Any, Dict[str, float]]] = None
@@ -95,6 +96,8 @@ class NodeAgent:
         self._hb_task: Optional[asyncio.Task] = None
         self._supervise_task: Optional[asyncio.Task] = None
         self._pull_locks: Dict[str, asyncio.Lock] = {}
+        self._recon_locks: Dict[str, asyncio.Lock] = {}
+        self._recon_attempts: Dict[str, int] = {}
         self._max_workers = max(1, int(ncpus))
         self._shutting_down = False
         # committed placement-group bundle reservations living on THIS node:
@@ -175,6 +178,11 @@ class NodeAgent:
         if w in self._idle_workers:
             self._idle_workers.remove(w)
         logger.warning("worker %s died (state=%s)", w.worker_id[:8], prev_state)
+        if w.client_holder:
+            try:
+                await self.gcs.call("drop_holder", holder=w.client_holder)
+            except Exception:  # noqa: BLE001
+                pass
         token = w._actor_token
         if token is not None:
             self._release_token(token)
@@ -212,10 +220,12 @@ class NodeAgent:
         self._workers[worker_id] = handle
         return handle
 
-    async def rpc_worker_ready(self, worker_id: str, address: str) -> bool:
+    async def rpc_worker_ready(self, worker_id: str, address: str,
+                               client_holder: str = "") -> bool:
         w = self._workers.get(worker_id)
         if w is None:
             return False
+        w.client_holder = client_holder or None
         w.address = address
         w.client = await RpcClient(address).connect()
         w.state = "IDLE"
@@ -256,13 +266,15 @@ class NodeAgent:
         return True
 
     async def rpc_seal_object(self, object_id: str, size: int, owner: str = "",
-                              is_error: bool = False) -> bool:
+                              is_error: bool = False,
+                              contained: Optional[List[str]] = None) -> bool:
         oid = ObjectID.from_hex(object_id)
         self.store.seal(oid)
         if is_error:
             self.error_objects.add(object_id)
         await self.gcs.call(
-            "register_object", object_id=object_id, size=size, node_id=self.hex, owner=owner
+            "register_object", object_id=object_id, size=size, node_id=self.hex,
+            owner=owner, contained=contained or None,
         )
         return True
 
@@ -317,6 +329,14 @@ class NodeAgent:
                                 "size": rec["size"],
                                 "is_error": object_id in self.error_objects,
                             }
+                elif rec and rec.get("lost"):
+                    # every copy died with its node: waiting is pointless —
+                    # re-execute the producing task from lineage (reference:
+                    # object_recovery_manager.h:41 + task resubmission,
+                    # task_manager.h:468). Raises if no lineage or the
+                    # reconstruction budget is exhausted.
+                    await self._reconstruct(object_id)
+                    continue  # lookup again: the re-run registered locations
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"object {object_id[:16]} not available")
                 await asyncio.sleep(backoff)
@@ -344,6 +364,56 @@ class NodeAgent:
             else:
                 out.append(res)
         return out
+
+    async def _reconstruct(self, object_id: str) -> None:
+        """Re-execute the task that produced a lost object, from GCS lineage.
+        Serialized per producing task (sibling return ids share one re-run);
+        raises ObjectLostError (no lineage — e.g. put() data or actor-task
+        returns) or ObjectReconstructionFailedError (budget exhausted)."""
+        from ray_tpu import exceptions as exc
+
+        spec = await self.gcs.call("get_lineage", object_id=object_id)
+        if spec is None:
+            raise exc.ObjectLostError(
+                object_id,
+                "all copies were lost with their nodes and the object has no "
+                "lineage (ray.put data and actor-task returns are not "
+                "reconstructable)",
+            )
+        task_key = spec.get("task_id", object_id)
+        attempts = self._recon_attempts.get(task_key, 0)
+        if attempts >= config.max_object_reconstructions:
+            raise exc.ObjectReconstructionFailedError(
+                f"object {object_id[:16]} lost again after "
+                f"{attempts} reconstruction attempts"
+            )
+        lock = self._recon_locks.setdefault(task_key, asyncio.Lock())
+        async with lock:
+            # another waiter may have reconstructed while we queued
+            rec = await self.gcs.call("lookup_object", object_id=object_id)
+            if rec and rec["locations"]:
+                return
+            self._recon_attempts[task_key] = self._recon_attempts.get(task_key, 0) + 1
+            logger.info(
+                "reconstructing %s (attempt %d): re-running task %s",
+                object_id[:16], self._recon_attempts[task_key], spec.get("name"),
+            )
+            if (spec.get("strategy") or {}).get("kind") == "node_affinity":
+                # the pinned node is typically the one that died; the original
+                # placement preference is moot for a re-run
+                spec = {**spec, "strategy": {"kind": "default"}}
+            # pin deps+returns for the re-run (removed by _submit_with_retries);
+            # dep objects that are themselves lost reconstruct recursively via
+            # the dispatch path's ensure_local.
+            pinned = (spec.get("deps") or []) + (spec.get("returns") or [])
+            try:
+                await self.gcs.call(
+                    "add_object_refs", object_ids=pinned,
+                    holder=self._task_holder(spec),
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            await self._submit_with_retries(spec)
 
     async def _pull(self, oid: ObjectID, size: int, locations: List[str]) -> bool:
         """Chunked pull from a peer agent (reference: PullManager/PushManager
@@ -452,9 +522,41 @@ class NodeAgent:
     # ------------------------------------------------------------ scheduling
     async def rpc_submit_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         """Entry from drivers/workers on this node. Returns {accepted: bool}.
-        Completion is observed through the object plane."""
+        Completion is observed through the object plane.
+
+        Before accepting, the task's deps + returns are PINNED at the GCS
+        under a task holder (so distributed GC can't free an argument while
+        the task is queued/running — the pin outlives the submitter's own
+        refs), the submitter's holder is registered on the returns, and the
+        spec is retained as lineage for reconstruction. Pinning completes
+        before this RPC returns, which closes the submit-then-drop race:
+        the caller's arg refs are still live during this call."""
+        returns: List[str] = spec.get("returns") or []
+        deps: List[str] = spec.get("deps") or []
+        try:
+            await self.gcs.call(
+                "pin_task",
+                task_holder=self._task_holder(spec),
+                deps=deps,
+                returns=returns,
+                submitter=spec.get("holder") or "",
+                spec=spec if (
+                    returns and self._lineage_size(spec) <= config.max_lineage_bytes
+                ) else None,
+            )
+        except Exception:  # noqa: BLE001 - pinning is best-effort bookkeeping
+            logger.exception("ref pinning failed")
         asyncio.ensure_future(self._submit_with_retries(spec))
         return {"accepted": True}
+
+    def _task_holder(self, spec: Dict[str, Any]) -> str:
+        # node-scoped so the GCS can drop this pin if the whole node dies
+        # before _submit_with_retries gets to remove it
+        return f"task:{spec.get('task_id', '')}@{self.hex}"
+
+    @staticmethod
+    def _lineage_size(spec: Dict[str, Any]) -> int:
+        return len(spec.get("args_payload") or b"")
 
     async def _submit_with_retries(self, spec: Dict[str, Any]) -> None:
         try:
@@ -465,6 +567,18 @@ class NodeAgent:
                 await self._store_error(spec, f"internal scheduling error: {e}")
             except Exception:  # noqa: BLE001
                 logger.exception("failed to store error objects")
+        finally:
+            # release the task pin: returns stay alive through the
+            # submitter's holder; deps fall back to their own holders
+            pinned = (spec.get("deps") or []) + (spec.get("returns") or [])
+            if pinned:
+                try:
+                    await self.gcs.call(
+                        "remove_object_refs", object_ids=pinned,
+                        holder=self._task_holder(spec),
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
 
     async def _submit_with_retries_inner(self, spec: Dict[str, Any]) -> None:
         max_retries = int(spec.get("max_retries", 0))

@@ -72,10 +72,16 @@ class WorkerProcess:
             worker_id=WorkerID.from_hex(self.worker_id.ljust(32, "0")[:32]),
             node_id=NodeID.from_hex(self.node_hex), is_driver=False,
         )
-        worker.ref_counter.set_on_zero(lambda oid: None)  # workers don't own eviction
+        # zero-refcount in a worker withdraws its cluster holder (borrowed
+        # refs); the GCS frees an object once every process's holder is gone
+        worker.ref_counter.set_on_zero(runtime.release)
         set_global_worker(worker)
         self._worker_ctx = worker
-        await self.agent.call("worker_ready", worker_id=self.worker_id, address=self.rpc.address)
+        self._runtime = runtime
+        await self.agent.call(
+            "worker_ready", worker_id=self.worker_id, address=self.rpc.address,
+            client_holder=runtime.client_id,
+        )
         logger.info("worker %s ready at %s", self.worker_id[:8], self.rpc.address)
 
     # ----------------------------------------------------------- helpers
@@ -111,7 +117,7 @@ class WorkerProcess:
         return tuple(resolve(a) for a in args), {k: resolve(v) for k, v in kwargs.items()}
 
     def _store_value(self, object_id: str, value: Any, is_error: bool = False) -> None:
-        payload, _refs = serialization.pack(value)
+        payload, refs = serialization.pack(value)
         oid = ObjectID.from_hex(object_id)
         fut = asyncio.run_coroutine_threadsafe(
             self.agent.call("create_object", object_id=object_id, size=len(payload)),
@@ -125,6 +131,7 @@ class WorkerProcess:
             self.agent.call(
                 "seal_object", object_id=object_id, size=len(payload),
                 owner=":error" if is_error else "", is_error=is_error,
+                contained=[r.id.hex() for r in refs] or None,
             ),
             self._loop,
         ).result()
@@ -183,6 +190,12 @@ class WorkerProcess:
                 return {"state": "error"}
             finally:
                 w.set_task_context(None)
+                # borrows registered during execution must reach the GCS
+                # while the task pin still protects them
+                try:
+                    self._runtime.flush_refs()
+                except Exception:  # noqa: BLE001
+                    pass
 
     # ------------------------------------------------------------ actor rpc
     async def rpc_start_actor(self, spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -264,6 +277,10 @@ class WorkerProcess:
             return {"state": "error"}
         finally:
             w.set_task_context(None)
+            try:
+                self._runtime.flush_refs()
+            except Exception:  # noqa: BLE001
+                pass
 
     async def rpc_terminate(self) -> bool:
         asyncio.get_event_loop().call_later(0.05, os._exit, 0)
