@@ -129,18 +129,31 @@ class ClusterRuntime(CoreRuntime):
         try:
             # One batched RPC: the agent pulls every object concurrently
             # (reference: plasma batched Get, src/ray/core_worker/
-            # store_provider/plasma_store_provider.cc).
-            rpc_deadline = None if timeout is None else timeout + 5.0
-            try:
-                infos = self.agent.call(
-                    "ensure_local_batch",
-                    object_ids=[r.id.hex() for r in refs],
-                    timeout=rpc_deadline, timeout_s=timeout,
-                )
-            except TimeoutError:
-                raise exc.GetTimeoutError(
-                    f"get() timed out waiting for {len(refs)} objects"
-                ) from None
+            # store_provider/plasma_store_provider.cc). Issued in bounded
+            # chunks and re-sent on RPC timeout (ensure_local is idempotent),
+            # so one dropped frame doesn't consume the whole user deadline —
+            # and a timeout=None get still survives connection hiccups.
+            deadline = None if timeout is None else time.monotonic() + timeout
+            ids = [r.id.hex() for r in refs]
+            while True:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {len(refs)} objects"
+                    )
+                attempt_s = 30.0 if remaining is None else min(remaining, 30.0)
+                try:
+                    infos = self.agent.call(
+                        "ensure_local_batch", object_ids=ids,
+                        timeout=attempt_s + 5.0, timeout_s=attempt_s,
+                    )
+                except TimeoutError:
+                    continue
+                if any(i.get("error_type") == "TimeoutError" for i in infos) and (
+                    remaining is None or remaining > attempt_s
+                ):
+                    continue  # per-object timeout but user deadline remains
+                break
             out = []
             for ref, info in zip(refs, infos):
                 if "error" in info:

@@ -156,6 +156,9 @@ _DEFINITIONS = [
     # --- rpc ---
     ("rpc_connect_timeout_s", 10.0, float, "Socket connect timeout."),
     ("rpc_call_timeout_s", 60.0, float, "Default RPC deadline."),
+    ("rpc_retry_attempt_timeout_s", 2.0, float,
+     "Per-attempt timeout for retry-safe RPC methods; the overall deadline "
+     "is still the call's timeout."),
     ("rpc_max_message_bytes", 512 * 1024 * 1024, int, "Max framed message size."),
     ("rpc_chaos_failure_prob", 0.0, float,
      "Fault injection: probability an RPC is dropped (request or response)."),

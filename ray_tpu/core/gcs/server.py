@@ -675,6 +675,13 @@ class GcsServer:
             "lost": not rec["locations"] and rec.get("had_locations", False),
         }
 
+    async def rpc_free_object_everywhere(self, object_id: str) -> bool:
+        """Explicit free: drop all bookkeeping and delete every copy.
+        Idempotent (safe for transparent RPC retries — the old destructive
+        pop-and-return-locations contract lost the fan-out on retry)."""
+        await self._free_everywhere(object_id)
+        return True
+
     async def rpc_free_object(self, object_id: str) -> List[str]:
         rec = self.objects.pop(object_id, None)
         self.object_holders.pop(object_id, None)

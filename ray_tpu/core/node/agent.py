@@ -98,6 +98,10 @@ class NodeAgent:
         self._pull_locks: Dict[str, asyncio.Lock] = {}
         self._recon_locks: Dict[str, asyncio.Lock] = {}
         self._recon_attempts: Dict[str, int] = {}
+        from collections import OrderedDict
+
+        # task_id -> accept time: dedupes retried submit_task RPCs
+        self._accepted_tasks: "OrderedDict[str, float]" = OrderedDict()
         self._max_workers = max(1, int(ncpus))
         self._shutting_down = False
         # committed placement-group bundle reservations living on THIS node:
@@ -225,6 +229,10 @@ class NodeAgent:
         w = self._workers.get(worker_id)
         if w is None:
             return False
+        if w.ready.is_set() and w.address == address:
+            # idempotent re-announce (retried RPC): the worker may already be
+            # LEASED — resetting state/re-listing it would double-lease it
+            return True
         w.client_holder = client_holder or None
         w.address = address
         w.client = await RpcClient(address).connect()
@@ -260,10 +268,23 @@ class NodeAgent:
             self._idle_workers.append(w)
 
     # ------------------------------------------------------------ object api
-    async def rpc_create_object(self, object_id: str, size: int) -> bool:
+    async def rpc_create_object(self, object_id: str, size: int) -> Dict[str, Any]:
+        """Idempotent reserve. ``existing``: None (fresh), "reserved" (a
+        retried create whose first response was dropped — caller should
+        attach and write), or "sealed" (object complete — caller must NOT
+        rewrite live-readable memory)."""
         oid = ObjectID.from_hex(object_id)
-        self.store.reserve(oid, size)
-        return True
+        try:
+            self.store.reserve(oid, size)
+            return {"ok": True, "existing": None}
+        except FileExistsError:
+            info = self.store.info(oid)
+            sealed = bool(info and info[1])
+            return {
+                "ok": True,
+                "existing": "sealed" if sealed else "reserved",
+                "size": info[0] if info else 0,
+            }
 
     async def rpc_seal_object(self, object_id: str, size: int, owner: str = "",
                               is_error: bool = False,
@@ -500,18 +521,11 @@ class NodeAgent:
 
     async def rpc_free_objects(self, object_ids: List[str]) -> bool:
         for object_id in object_ids:
-            locations = await self.gcs.call("free_object", object_id=object_id)
-            for node_id in locations:
-                if node_id == self.hex:
-                    self.store.delete(ObjectID.from_hex(object_id))
-                    self.error_objects.discard(object_id)
-                else:
-                    client = await self._peer(node_id)
-                    if client is not None:
-                        try:
-                            await client.call("delete_local_object", object_id=object_id)
-                        except Exception:  # noqa: BLE001
-                            pass
+            # prompt local delete, then the GCS fans out to every other
+            # location (idempotent — a retried RPC re-frees nothing)
+            self.store.delete(ObjectID.from_hex(object_id))
+            self.error_objects.discard(object_id)
+            await self.gcs.call("free_object_everywhere", object_id=object_id)
         return True
 
     async def rpc_delete_local_object(self, object_id: str) -> bool:
@@ -531,6 +545,12 @@ class NodeAgent:
         spec is retained as lineage for reconstruction. Pinning completes
         before this RPC returns, which closes the submit-then-drop race:
         the caller's arg refs are still live during this call."""
+        tid = spec.get("task_id", "")
+        if tid in self._accepted_tasks:
+            return {"accepted": True}  # duplicate submit (retried RPC): dedupe
+        self._accepted_tasks[tid] = time.monotonic()
+        while len(self._accepted_tasks) > 20000:
+            self._accepted_tasks.popitem(last=False)
         returns: List[str] = spec.get("returns") or []
         deps: List[str] = spec.get("deps") or []
         try:

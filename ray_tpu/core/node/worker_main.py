@@ -40,7 +40,9 @@ class WorkerProcess:
         self.agent_addr = os.environ["RAY_TPU_AGENT_ADDR"]
         self.gcs_addr = os.environ["RAY_TPU_GCS_ADDR"]
         self.node_hex = os.environ["RAY_TPU_NODE_ID"]
-        self.rpc = RpcServer("127.0.0.1", 0)
+        # chaos-exempt: task/actor-call execution is not idempotent (the
+        # chaos tier targets the control plane — GCS + agents)
+        self.rpc = RpcServer("127.0.0.1", 0, chaos=False)
         self.rpc.register_object(self)
         self.agent: Optional[RpcClient] = None
         self._fn_cache: Dict[str, Any] = {}
@@ -123,7 +125,22 @@ class WorkerProcess:
             self.agent.call("create_object", object_id=object_id, size=len(payload)),
             self._loop,
         )
-        fut.result()
+        resp = fut.result()
+        if isinstance(resp, dict) and resp.get("existing") == "sealed":
+            # a previous execution of this task already stored the result;
+            # never rewrite memory that readers may be consuming
+            raise FileExistsError(object_id)
+        if (isinstance(resp, dict) and resp.get("existing") == "reserved"
+                and resp.get("size") != len(payload)):
+            # stale half-written reservation from a crashed execution with a
+            # DIFFERENT payload size: recreate at the right size
+            asyncio.run_coroutine_threadsafe(
+                self.agent.call("abort_object", object_id=object_id), self._loop
+            ).result()
+            asyncio.run_coroutine_threadsafe(
+                self.agent.call("create_object", object_id=object_id, size=len(payload)),
+                self._loop,
+            ).result()
         writer = ShmWriter(oid, len(payload), self.node_hex)
         writer.buffer[:] = payload
         writer.seal()
@@ -139,7 +156,10 @@ class WorkerProcess:
     def _store_returns(self, spec: Dict[str, Any], result: Any) -> None:
         returns: List[str] = spec["returns"]
         if len(returns) == 1:
-            self._store_value(returns[0], result)
+            try:
+                self._store_value(returns[0], result)
+            except FileExistsError:
+                pass  # duplicate execution (at-least-once): result already stored
             return
         if not isinstance(result, (tuple, list)) or len(result) != len(returns):
             err = exc.TaskError(
@@ -148,10 +168,16 @@ class WorkerProcess:
                 f"{type(result).__name__}",
             )
             for r in returns:
-                self._store_value(r, err, is_error=True)
+                try:
+                    self._store_value(r, err, is_error=True)
+                except FileExistsError:
+                    pass
             return
         for r, v in zip(returns, result):
-            self._store_value(r, v)
+            try:
+                self._store_value(r, v)
+            except FileExistsError:
+                pass  # duplicate execution (at-least-once): already stored
 
     def _store_error_returns(self, spec: Dict[str, Any], e: BaseException) -> None:
         err = exc.TaskError.from_exception(

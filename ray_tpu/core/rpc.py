@@ -62,8 +62,8 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
 
 
 class _Chaos:
-    def __init__(self) -> None:
-        prob = config.rpc_chaos_failure_prob
+    def __init__(self, enabled: bool = True) -> None:
+        prob = config.rpc_chaos_failure_prob if enabled else 0.0
         self.prob = prob
         self.rng = random.Random(config.rpc_chaos_seed or None) if prob > 0 else None
 
@@ -71,10 +71,38 @@ class _Chaos:
         return self.rng is not None and self.rng.random() < self.prob
 
 
-class RpcServer:
-    """Serves handler coroutines; also supports pushing to subscribed clients."""
+# Methods a client may transparently re-send after a (possibly chaos-induced)
+# timeout. Every entry is idempotent on the server: reads, set-semantics
+# ref-count updates, re-registrations, and the deduplicated task submit. Calls
+# with data-plane side effects that are NOT safely repeatable (run_actor_task
+# mutating actor state, dispatch/run_task long-running executions) stay out.
+RETRY_SAFE_METHODS = frozenset({
+    "ping", "get_nodes", "heartbeat", "register_node", "cluster_resources",
+    "available_resources", "node_info", "debug_state",
+    "kv_put", "kv_get", "kv_del", "kv_keys",
+    "schedule", "lookup_object", "register_object", "remove_object_location",
+    "object_info", "read_chunk", "free_object_everywhere", "delete_local_object",
+    "add_object_refs", "remove_object_refs", "pin_task", "drop_holder",
+    "holder_heartbeat", "object_ref_counts", "put_lineage", "get_lineage",
+    "get_actor", "get_actor_spec", "get_named_actor", "list_named_actors",
+    "list_actors", "actor_started", "placement_group_info",
+    "placement_group_table", "reserve_bundle", "return_bundle",
+    "create_object", "seal_object", "abort_object", "store_error",
+    "submit_task", "worker_ready", "worker_blocked", "worker_unblocked",
+    "__subscribe__",
+})
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+
+class RpcServer:
+    """Serves handler coroutines; also supports pushing to subscribed clients.
+
+    ``chaos=False`` exempts this server from fault injection — used by worker
+    processes, whose task/actor-call handlers are not idempotent (the chaos
+    tier targets the control plane: GCS + node agents, like the reference's
+    rpc_chaos on GCS RPCs)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, chaos: bool = True):
+        self._chaos_enabled = chaos
         self.host = host
         self.port = port
         self._handlers: Dict[str, Callable[..., Awaitable[Any]]] = {}
@@ -103,7 +131,7 @@ class RpcServer:
                 self._handlers[prefix + attr[4:]] = getattr(obj, attr)
 
     async def start(self) -> Tuple[str, int]:
-        self._chaos = _Chaos()
+        self._chaos = _Chaos(self._chaos_enabled)
         self._server = await asyncio.start_server(self._on_client, self.host, self.port)
         sock = self._server.sockets[0]
         self.host, self.port = sock.getsockname()[:2]
@@ -253,6 +281,32 @@ class RpcClient:
             self._pending.clear()
 
     async def call(self, method: str, timeout: Any = DEFAULT_TIMEOUT, **params) -> Any:
+        if timeout is DEFAULT_TIMEOUT:
+            timeout = config.rpc_call_timeout_s
+        if timeout is not None and method in RETRY_SAFE_METHODS:
+            # at-least-once within the deadline: a dropped request/response
+            # (chaos, transient network) is re-sent with a short per-attempt
+            # timeout instead of burning the whole deadline on one try
+            deadline = asyncio.get_event_loop().time() + timeout
+            # per-attempt window doubles each retry so a legitimately-slow
+            # call (big read_chunk, spill restore, busy scheduler) still gets
+            # a long attempt before the overall deadline, while fast drops
+            # are re-sent quickly
+            attempt_timeout = max(0.2, config.rpc_retry_attempt_timeout_s)
+            while True:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    raise TimeoutError(f"rpc {method} timed out after {timeout}s")
+                try:
+                    return await self._call_once(
+                        method, min(attempt_timeout, remaining), params
+                    )
+                except TimeoutError:
+                    attempt_timeout *= 2
+                    continue
+        return await self._call_once(method, timeout, params)
+
+    async def _call_once(self, method: str, timeout: Optional[float], params: Dict) -> Any:
         if self._closed:
             raise RpcConnectionError("client closed")
         req_id = next(self._ids)
@@ -261,8 +315,6 @@ class RpcClient:
         async with self._send_lock:
             self._writer.write(_pack({"i": req_id, "m": method, "p": params}))
             await self._writer.drain()
-        if timeout is DEFAULT_TIMEOUT:
-            timeout = config.rpc_call_timeout_s
         try:
             if timeout is None:
                 return await fut  # infinite deadline (connection loss still errors)

@@ -59,9 +59,13 @@ class ShmWriter:
     def __init__(self, oid: ObjectID, size: int, node_suffix: str):
         self.oid = oid
         self.size = size
-        self._shm = shared_memory.SharedMemory(
-            name=segment_name(oid, node_suffix), create=True, size=max(size, 1)
-        )
+        name = segment_name(oid, node_suffix)
+        try:
+            self._shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        except FileExistsError:
+            # a retried create (dropped RPC response) already made the
+            # segment; attach and (re)write the identical bytes
+            self._shm = shared_memory.SharedMemory(name=name, create=False)
         _untrack(self._shm)
 
     @property

@@ -1,0 +1,108 @@
+"""External placement-policy service (reference: external_scheduler/test_scheduler.py)."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.core.rpc import SyncRpcClient
+
+
+class _PolicyServer(threading.Thread):
+    """Minimal line-JSON external placement policy: pins every request to one
+    chosen node and records everything it saw (protocol: gcs/external_policy.py)."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.nodes = []
+        self.batches = []
+        self.pin_node = None
+        self.lock = threading.Lock()
+
+    def run(self):
+        try:
+            conn, _ = self.sock.accept()
+        except OSError:
+            return
+        buf = b""
+        while True:
+            try:
+                data = conn.recv(65536)
+            except OSError:
+                return
+            if not data:
+                return
+            buf += data
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                msg = json.loads(line)
+                with self.lock:
+                    if msg["op"] == "add_node":
+                        self.nodes.append(msg["node_id"])
+                        if self.pin_node is None:
+                            self.pin_node = msg["node_id"]
+                    elif msg["op"] == "remove_node":
+                        self.nodes = [n for n in self.nodes if n != msg["node_id"]]
+                    elif msg["op"] == "schedule":
+                        self.batches.append(msg)
+                        placements = [self.pin_node for _ in msg["requests"]]
+                        conn.sendall((json.dumps(
+                            {"batch_id": msg["batch_id"], "placements": placements}
+                        ) + "\n").encode())
+
+
+@pytest.fixture(scope="module")
+def external_policy_setup():
+    server = _PolicyServer()
+    server.start()
+    os.environ["RAY_TPU_EXTERNAL_SCHEDULER_ADDRESS"] = f"127.0.0.1:{server.port}"
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        ray_tpu.init(address=c.gcs_address)
+        yield c, server
+        ray_tpu.shutdown()
+        c.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_EXTERNAL_SCHEDULER_ADDRESS", None)
+        server.sock.close()
+
+
+def test_external_policy_receives_and_places(external_policy_setup):
+    cluster, server = external_policy_setup
+
+    @ray_tpu.remote
+    def where():
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    nodes = ray_tpu.get([where.remote() for _ in range(6)], timeout=120)
+    with server.lock:
+        assert server.nodes, "policy never saw node registrations"
+        assert server.batches, "policy never saw schedule batches"
+        pin = server.pin_node
+    # the policy pinned every task to the first-registered node and the
+    # cluster honored it
+    assert set(nodes) == {pin}, (nodes, pin)
+
+
+def test_external_policy_sees_batched_requests(external_policy_setup):
+    cluster, server = external_policy_setup
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    assert sorted(ray_tpu.get([noop.remote(i) for i in range(10)], timeout=120)) == list(range(10))
+    with server.lock:
+        reqs = [len(b["requests"]) for b in server.batches]
+        assert all("nodes" in b for b in server.batches)
+    assert sum(reqs) >= 10
