@@ -84,6 +84,11 @@ class ClusterRuntime(CoreRuntime):
         self._ref_flusher: Optional[threading.Thread] = None
         self._ref_stop = threading.Event()
         self._last_holder_hb = 0.0
+        # the flusher doubles as the holder-lease heartbeat, so it must run
+        # from the first moment this process can hold refs (a driver that
+        # only submits tasks — no put() — still holds its task returns;
+        # without heartbeats the GCS would reap them after the lease)
+        self._start_ref_flusher()
         self._exported_fns: set = set()
         self._actor_clients: Dict[str, SyncRpcClient] = {}
         self._actor_cache: Dict[str, Dict[str, Any]] = {}
@@ -141,7 +146,10 @@ class ClusterRuntime(CoreRuntime):
                     raise exc.GetTimeoutError(
                         f"get() timed out waiting for {len(refs)} objects"
                     )
-                attempt_s = 30.0 if remaining is None else min(remaining, 30.0)
+                # short chunks: ensure_local can't distinguish "frame
+                # dropped" from "object not ready yet", so a small window
+                # bounds what one lost frame costs; re-issue is idempotent
+                attempt_s = 5.0 if remaining is None else min(remaining, 5.0)
                 try:
                     infos = self.agent.call(
                         "ensure_local_batch", object_ids=ids,
@@ -200,15 +208,18 @@ class ClusterRuntime(CoreRuntime):
         self.agent.call("free_objects", object_ids=[r.id.hex() for r in refs])
 
     # ------------------------------------------------- distributed ref counts
-    def _queue_ref_op(self, op: str, oid_hex: str) -> None:
+    def _start_ref_flusher(self) -> None:
         with self._ref_lock:
-            self._ref_ops.append((op, oid_hex))
             if self._ref_flusher is None:
                 self._ref_flusher = threading.Thread(
                     target=self._ref_flush_loop, daemon=True,
                     name=f"ref-sync-{self.client_id[2:10]}",
                 )
                 self._ref_flusher.start()
+
+    def _queue_ref_op(self, op: str, oid_hex: str) -> None:
+        with self._ref_lock:
+            self._ref_ops.append((op, oid_hex))
 
     def _ref_flush_loop(self) -> None:
         while not self._ref_stop.wait(config.ref_sync_interval_s):

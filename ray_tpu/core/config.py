@@ -121,6 +121,12 @@ _DEFINITIONS = [
     ("max_lineage_bytes", 8 * 1024 * 1024, int,
      "Task specs above this size are not retained for lineage reconstruction."),
     # --- scheduling ---
+    ("local_queue_wait_s", 0.5, float,
+     "How long a task queues at a busy node before spilling back to global "
+     "placement (the raylet local-queue analogue)."),
+    ("scheduler_batch_ms", 5, int,
+     "Agent-side coalescing window for GCS placement requests (one batched "
+     "schedule RPC per tick instead of a round trip per task)."),
     ("scheduler_spread_threshold", 0.5, float,
      "Hybrid policy: pack onto nodes below this utilization, then spread."),
     ("scheduler_top_k_fraction", 0.2, float,

@@ -80,6 +80,8 @@ class GcsServer:
         # object_holder_lease_s = crashed process, drop its holders.
         self.holder_last_seen: Dict[str, float] = {}
         self._gc_task: Optional[asyncio.Task] = None
+        self._schedule_calls = 0  # batched RPCs received
+        self._schedule_reqs = 0   # placement requests inside them
 
     async def start(self) -> Tuple[str, int]:
         host, port = await self.rpc.start()
@@ -237,6 +239,8 @@ class GcsServer:
         {resources, strategy: {kind, node_id?, soft?, labels?, pg?, bundle?}}
         Returns a node_id hex (or None = infeasible right now) per request.
         """
+        self._schedule_calls += 1
+        self._schedule_reqs += len(requests)
         if self._external is not None:
             return await self._external.schedule_batch(requests, self)
         return [self._schedule_one(r) for r in requests]
@@ -841,6 +845,8 @@ class GcsServer:
             "lineage_entries": len(self.lineage),
             "pgs": len(self.pgs),
             "kv_keys": len(self.kv),
+            "schedule_calls": self._schedule_calls,
+            "schedule_requests": self._schedule_reqs,
             "uptime_s": time.time() - self._started_at,
         }
 

@@ -102,6 +102,11 @@ class NodeAgent:
 
         # task_id -> accept time: dedupes retried submit_task RPCs
         self._accepted_tasks: "OrderedDict[str, float]" = OrderedDict()
+        # coalescing queue for GCS placement requests (one RPC per tick)
+        self._sched_queue: List[Tuple[Dict[str, Any], asyncio.Future]] = []
+        self._sched_drainer: Optional[asyncio.Task] = None
+        # task_id -> lifecycle state (observability; state API reads this)
+        self._task_states: Dict[str, str] = {}
         self._max_workers = max(1, int(ncpus))
         self._shutting_down = False
         # committed placement-group bundle reservations living on THIS node:
@@ -600,26 +605,124 @@ class NodeAgent:
                 except Exception:  # noqa: BLE001
                     pass
 
+    def _can_grant_locally(self, spec: Dict[str, Any]) -> bool:
+        """Local-first fast path (reference two-level design:
+        cluster_resource_scheduler.cc:150 + local_task_manager.h:58): grant
+        on THIS node without a control-plane round trip when the strategy has
+        no global placement intent and resources fit right now. Everything
+        else — SPREAD, labels, affinity to other nodes, unfit — goes through
+        the (batched) GCS path with spillback."""
+        if config.external_scheduler_address:
+            # an external placement policy has authority over EVERY placement
+            # (the fork's contract); the local fast path would bypass it
+            return False
+        strat = spec.get("strategy") or {}
+        kind = strat.get("kind", "default")
+        if kind == "node_affinity":
+            if strat.get("node_id") != self.hex:
+                return False
+        elif kind != "placement_group" and (kind != "default" or strat.get("labels")):
+            return False
+        # the SAME code path the real acquire uses, in dry-run mode, so the
+        # fast-path check can never drift from acquire semantics
+        return self._acquire_for_spec(spec, dry_run=True) is not None
+
+    async def _schedule_via_gcs(self, spec: Dict[str, Any]) -> Optional[str]:
+        """Batched placement: requests arriving within one tick coalesce into
+        a single GCS `schedule` RPC (the fork's measured failure mode was a
+        control-plane round trip per lease; SURVEY §6)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._sched_queue.append((
+            {"resources": spec.get("resources") or {},
+             "strategy": spec.get("strategy") or {}},
+            fut,
+        ))
+        if self._sched_drainer is None or self._sched_drainer.done():
+            self._sched_drainer = asyncio.ensure_future(self._drain_sched_queue())
+        return await fut
+
+    async def _drain_sched_queue(self) -> None:
+        try:
+            while self._sched_queue:
+                await asyncio.sleep(config.scheduler_batch_ms / 1000.0)
+                batch, self._sched_queue = self._sched_queue, []
+                if not batch:
+                    continue
+                try:
+                    placements = await self.gcs.call(
+                        "schedule", requests=[r for r, _ in batch]
+                    )
+                except RpcError:
+                    # a handler-level error (e.g. one request's invalid PG
+                    # bundle index) must not fail the whole batch: isolate it
+                    # by re-scheduling each request individually
+                    await self._schedule_batch_individually(batch)
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(e)
+                    continue
+                if not isinstance(placements, list) or len(placements) != len(batch):
+                    # malformed scheduler reply (e.g. buggy external policy):
+                    # fail loudly instead of stranding the tail futures forever
+                    err = RpcError(
+                        "SchedulerProtocolError",
+                        f"scheduler returned {len(placements) if isinstance(placements, list) else type(placements).__name__} "
+                        f"placements for {len(batch)} requests",
+                    )
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(err)
+                    continue
+                for (_, fut), target in zip(batch, placements):
+                    if not fut.done():
+                        fut.set_result(target)
+        finally:
+            # no await between the while-exit and this check, so an enqueue
+            # cannot slip in unseen (single-threaded loop): if one raced in
+            # during the last batch's processing, hand off to a fresh drainer
+            # rather than strand its future (lost-wakeup)
+            if self._sched_queue:
+                self._sched_drainer = asyncio.ensure_future(self._drain_sched_queue())
+
+    async def _schedule_batch_individually(
+        self, batch: List[Tuple[Dict[str, Any], asyncio.Future]]
+    ) -> None:
+        for req, fut in batch:
+            if fut.done():
+                continue
+            try:
+                placements = await self.gcs.call("schedule", requests=[req])
+                fut.set_result(placements[0] if placements else None)
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
     async def _submit_with_retries_inner(self, spec: Dict[str, Any]) -> None:
         max_retries = int(spec.get("max_retries", 0))
+        tid = spec.get("task_id", "")
         attempt = 0
         last_error = "unknown"
+        skip_local = False  # set after a local busy-grant: spill back via GCS
         while attempt <= max_retries:
             target = None
-            try:
-                placements = await self.gcs.call(
-                    "schedule",
-                    requests=[{"resources": spec.get("resources") or {},
-                               "strategy": spec.get("strategy") or {}}],
-                )
-                target = placements[0] if placements else None
-            except RpcError as e:
-                # handler-level failure (e.g. invalid placement-group index)
-                # is fatal for the task: materialize the error for get()
-                await self._store_error(spec, f"scheduling failed: {e}")
-                return
-            except (RpcConnectionError, TimeoutError) as e:
-                last_error = f"scheduler unavailable: {e}"
+            self._set_task_state(tid, "scheduling")
+            if not skip_local and self._can_grant_locally(spec):
+                target = self.hex
+            else:
+                try:
+                    target = await self._schedule_via_gcs(spec)
+                except RpcError as e:
+                    # handler-level failure (e.g. invalid placement-group
+                    # index) is fatal: materialize the error for get()
+                    self._set_task_state(tid, "failed")
+                    await self._store_error(spec, f"scheduling failed: {e}")
+                    return
+                except (RpcConnectionError, TimeoutError) as e:
+                    last_error = f"scheduler unavailable: {e}"
+            skip_local = False
+            self._set_task_state(tid, f"placed:{(target or 'none')[:8]}")
             if target is None:
                 # infeasible now: backoff-retry without consuming an attempt
                 feasible = await self._check_feasible(spec)
@@ -641,21 +744,27 @@ class NodeAgent:
                         raise RpcConnectionError(f"no route to node {target[:8]}")
                     result = await peer.call("dispatch_task", spec=spec, timeout=None)
                 if result.get("ok"):
+                    self._set_task_state(tid, "finished")
                     return
                 if not result.get("retryable", True):
+                    self._set_task_state(tid, "failed")
                     return  # error object already stored by executor
                 last_error = result.get("error", "dispatch failed")
                 if result.get("reason") == "busy":
                     # spillback: the task is merely QUEUED (resources/worker
                     # busy on the chosen node) — not a failure; re-place
                     # without consuming a retry attempt (reference: lease
-                    # spillback never burns task retries)
+                    # spillback never burns task retries). If the busy grant
+                    # was the local fast path, consult the GCS next round.
+                    skip_local = target == self.hex
                     await asyncio.sleep(0.02)
                     continue
             except (RpcConnectionError, RpcError, TimeoutError) as e:
                 last_error = str(e)
+            self._set_task_state(tid, f"retrying:{last_error[:40]}")
             attempt += 1
             await asyncio.sleep(min(0.05 * (2 ** attempt), 1.0))
+        self._set_task_state(tid, "failed")
         await self._store_error(
             spec, f"Task {spec.get('name')} failed after {max_retries} retries: {last_error}",
             error_type="WorkerCrashedError",
@@ -681,8 +790,17 @@ class NodeAgent:
                 await self.rpc_ensure_local(dep, timeout_s=config.worker_lease_timeout_s * 10)
         except TimeoutError as e:
             return {"ok": False, "retryable": True, "reason": "busy", "error": f"deps unavailable: {e}"}
-        # 2. resources (PG tasks draw from their committed bundle)
+        # 2. resources (PG tasks draw from their committed bundle). Busy is
+        # first absorbed by a short LOCAL wait — tasks queue at the node like
+        # the reference raylet's local task queue — and only then reported
+        # back for (GCS) spillback, which avoids a control-plane round trip
+        # per 10ms of contention.
         token = self._acquire_for_spec(spec)
+        if token is None:
+            deadline = time.monotonic() + config.local_queue_wait_s
+            while token is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+                token = self._acquire_for_spec(spec)
         if token is None:
             return {"ok": False, "retryable": True, "reason": "busy", "error": "resources busy"}
         # 3. worker lease + push
@@ -708,12 +826,13 @@ class NodeAgent:
             w.lease_token = None
             self._release_worker(w)
 
-    def _try_acquire(self, resources: Dict[str, float]) -> bool:
+    def _try_acquire(self, resources: Dict[str, float], dry_run: bool = False) -> bool:
         for k, v in resources.items():
             if self.available.get(k, 0.0) + 1e-9 < v:
                 return False
-        for k, v in resources.items():
-            self.available[k] = self.available.get(k, 0.0) - v
+        if not dry_run:
+            for k, v in resources.items():
+                self.available[k] = self.available.get(k, 0.0) - v
         return True
 
     def _release_resources(self, resources: Dict[str, float]) -> None:
@@ -744,10 +863,14 @@ class NodeAgent:
                 self._release_resources(rec["total"])
         return True
 
-    def _acquire_for_spec(self, spec: Dict[str, Any]) -> Optional[Tuple[str, Any, Dict[str, float]]]:
+    def _acquire_for_spec(self, spec: Dict[str, Any], dry_run: bool = False
+                          ) -> Optional[Tuple[str, Any, Dict[str, float]]]:
         """Acquire execution resources for a task/actor spec. PG-scheduled
         work draws from its committed bundle; everything else from the node
-        pool. Returns an opaque token for _release_token, or None if busy."""
+        pool. Returns an opaque token for _release_token, or None if busy.
+        ``dry_run`` answers "would this acquire succeed" without mutating —
+        the local-first fast path uses it so grant checks can't drift from
+        acquire semantics."""
         resources = spec.get("resources") or {}
         strat = spec.get("strategy") or {}
         if strat.get("kind") == "placement_group":
@@ -758,11 +881,12 @@ class NodeAgent:
             for key in sorted(keys, key=lambda k: k[1]):
                 avail = self._pg_bundles[key]["avail"]
                 if all(avail.get(r, 0.0) + 1e-9 >= v for r, v in resources.items()):
-                    for r, v in resources.items():
-                        avail[r] = avail.get(r, 0.0) - v
+                    if not dry_run:
+                        for r, v in resources.items():
+                            avail[r] = avail.get(r, 0.0) - v
                     return ("bundle", key, resources)
             return None
-        if self._try_acquire(resources):
+        if self._try_acquire(resources, dry_run=dry_run):
             return ("node", None, resources)
         return None
 
@@ -871,6 +995,14 @@ class NodeAgent:
         return False
 
     # ------------------------------------------------------------------ info
+    def _set_task_state(self, tid: str, state: str) -> None:
+        self._task_states[tid] = state
+        while len(self._task_states) > 20000:  # bounded, like _accepted_tasks
+            self._task_states.pop(next(iter(self._task_states)))
+
+    async def rpc_task_states(self) -> Dict[str, str]:
+        return dict(self._task_states)
+
     async def rpc_node_info(self) -> Dict[str, Any]:
         return {
             "node_id": self.hex,

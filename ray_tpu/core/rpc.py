@@ -79,6 +79,7 @@ class _Chaos:
 RETRY_SAFE_METHODS = frozenset({
     "ping", "get_nodes", "heartbeat", "register_node", "cluster_resources",
     "available_resources", "node_info", "debug_state",
+    "next_job_id",  # retry burns an id from the sequence — gaps are fine
     "kv_put", "kv_get", "kv_del", "kv_keys",
     "schedule", "lookup_object", "register_object", "remove_object_location",
     "object_info", "read_chunk", "free_object_everywhere", "delete_local_object",

@@ -1,0 +1,91 @@
+"""Core-runtime microbenchmarks (reference: python/ray/_private/ray_perf.py
+:120-241 — tasks/sec, actor calls/sec, put/get throughput).
+
+Usage:
+    python tools/ray_perf.py            # in-process local runtime
+    python tools/ray_perf.py --cluster  # real multi-process cluster (1 node)
+
+Prints one JSON line per metric.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def bench(name, fn, n, unit="ops/s"):
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    print(json.dumps({"metric": name, "value": round(rate, 1), "unit": unit,
+                      "n": n, "seconds": round(dt, 3)}))
+    return rate
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster", action="store_true",
+                        help="run against a real multi-process cluster")
+    parser.add_argument("--scale", type=float, default=1.0,
+                        help="multiply iteration counts")
+    args = parser.parse_args()
+
+    import ray_tpu
+
+    cluster = None
+    if args.cluster:
+        from ray_tpu.cluster import Cluster
+
+        cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+        ray_tpu.init(address=cluster.gcs_address)
+    else:
+        ray_tpu.init(num_cpus=4)
+
+    s = args.scale
+
+    @ray_tpu.remote
+    def nop():
+        return 0
+
+    @ray_tpu.remote
+    class Actor:
+        def nop(self):
+            return 0
+
+    # warmup (worker spawn, function export)
+    ray_tpu.get([nop.remote() for _ in range(10)], timeout=120)
+
+    def tasks_submit_get(n):
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=600)
+
+    _put_refs = []
+
+    def puts(n):
+        _put_refs.extend(ray_tpu.put(i) for i in range(n))
+
+    def batched_get(n):
+        ray_tpu.get(_put_refs[:n], timeout=600)
+
+    def actor_calls(n):
+        a = Actor.remote()
+        ray_tpu.get([a.nop.remote() for _ in range(n)], timeout=600)
+
+    mode = "cluster" if args.cluster else "local"
+    bench(f"{mode}_tasks_per_sec", tasks_submit_get, int(500 * s))
+    bench(f"{mode}_puts_per_sec", puts, int(1000 * s))
+    bench(f"{mode}_batched_get_per_sec", batched_get, int(1000 * s))
+    bench(f"{mode}_actor_calls_per_sec", actor_calls, int(500 * s))
+
+    ray_tpu.shutdown()
+    if cluster is not None:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()
