@@ -30,11 +30,16 @@ class NodeHandle:
         self.node_id = node_id
 
     def kill(self) -> None:
-        """Hard-kill the node agent (and its workers die with the session)."""
+        """Hard-kill the node agent AND its worker children (same process
+        group via start_new_session; a bare agent SIGKILL would orphan the
+        workers until their agent-watchdog notices)."""
         try:
-            self.proc.send_signal(signal.SIGKILL)
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
         except Exception:
-            pass
+            try:
+                self.proc.send_signal(signal.SIGKILL)
+            except Exception:
+                pass
 
 
 class Cluster:
@@ -83,6 +88,7 @@ class Cluster:
         self._gcs_proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.gcs.server", "--ready-file", ready],
             env=self._env(), stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
         )
         self.gcs_address = self._wait_ready_file(ready, self._gcs_proc, "GCS")
         logger.info("GCS at %s (session %s)", self.gcs_address, self.session_dir)
@@ -114,7 +120,8 @@ class Cluster:
             cmd.append("--head")
         for k, v in (labels or {}).items():
             cmd += ["--label", f"{k}={v}"]
-        proc = subprocess.Popen(cmd, env=self._env(), stdout=log, stderr=subprocess.STDOUT)
+        proc = subprocess.Popen(cmd, env=self._env(), stdout=log, stderr=subprocess.STDOUT,
+                                start_new_session=True)
         address = self._wait_ready_file(ready, proc, "node agent")
         handle = NodeHandle(proc, address)
         self.nodes.append(handle)
@@ -146,9 +153,12 @@ class Cluster:
             node.kill()
         if self._gcs_proc is not None:
             try:
-                self._gcs_proc.kill()
+                os.killpg(os.getpgid(self._gcs_proc.pid), signal.SIGKILL)
             except Exception:
-                pass
+                try:
+                    self._gcs_proc.kill()
+                except Exception:
+                    pass
         time.sleep(0.1)
         shutil.rmtree(self.session_dir, ignore_errors=True)
         # best-effort shm cleanup for segments the agents left behind

@@ -384,6 +384,11 @@ class GcsServer:
             for k, v in need.items():
                 a[k] = a.get(k, 0.0) - v
 
+        def slice_of(node: str) -> Optional[str]:
+            from ray_tpu.core.accelerators import SLICE_LABEL
+
+            return self.nodes.get(node, {}).get("Labels", {}).get(SLICE_LABEL)
+
         order = sorted(range(len(bundles)), key=lambda i: -sum(bundles[i].values()))
         used_nodes: Set[str] = set()
         for i in order:
@@ -393,13 +398,28 @@ class GcsServer:
                 nodes = [n for n in nodes if n not in used_nodes]
             elif strategy == "STRICT_PACK":
                 if used_nodes:
-                    nodes = [n for n in nodes if n in used_nodes]
+                    # TPU topology: STRICT_PACK means "one ICI domain" — the
+                    # same node, or any node of the SAME SLICE when the gang
+                    # started on a slice-labelled node (multi-host slices are
+                    # several agents sharing ray_tpu.io/slice; collectives
+                    # ride ICI within the slice, DCN across slices)
+                    gang_slices = {slice_of(n) for n in used_nodes}
+                    gang_slice = next(iter(gang_slices)) if len(gang_slices) == 1 else None
+                    if gang_slice is not None:
+                        nodes = [n for n in nodes
+                                 if n in used_nodes or slice_of(n) == gang_slice]
+                    else:
+                        nodes = [n for n in nodes if n in used_nodes]
             elif strategy == "PACK":
                 packed = [n for n in nodes if n in used_nodes]
                 nodes = packed or nodes
             elif strategy == "SPREAD":
+                # prefer untouched nodes; among those, prefer untouched SLICES
+                # (one bundle per failure/bandwidth domain first)
                 fresh = [n for n in nodes if n not in used_nodes]
-                nodes = fresh or nodes
+                used_slices = {slice_of(n) for n in used_nodes} - {None}
+                fresh_slices = [n for n in fresh if slice_of(n) not in used_slices]
+                nodes = fresh_slices or fresh or nodes
             if not nodes:
                 return None
             choice = nodes[0]

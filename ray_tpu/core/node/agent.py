@@ -50,6 +50,7 @@ class _WorkerHandle:
         self.lease_token: Optional[Tuple[str, Any, Dict[str, float]]] = None
         self._actor_token: Optional[Tuple[str, Any, Dict[str, float]]] = None
         self.blocked = False
+        self.tpu_chips: Optional[Tuple[int, ...]] = None  # dedicated chip subset
 
 
 class NodeAgent:
@@ -72,13 +73,23 @@ class NodeAgent:
         self.rpc = RpcServer(host, port)
         self.rpc.register_object(self)
         self.is_head = is_head
+        from ray_tpu.core import accelerators
+
         ncpus = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
         self.total_resources: Dict[str, float] = {"CPU": float(ncpus), **(resources or {})}
+        # TPU slice/pod model: explicit num_tpus wins; otherwise auto-detect
+        # chips + slice-head resource + topology labels (accelerators.py)
         if num_tpus:
             self.total_resources["TPU"] = float(num_tpus)
+        else:
+            self.total_resources.update(accelerators.node_tpu_resources())
+        self._total_chips = int(self.total_resources.get("TPU", 0))
+        self._free_chips: List[int] = list(range(self._total_chips))
+        # chip-set tuple -> idle dedicated TPU workers (libtpu stays warm)
+        self._tpu_idle: Dict[Tuple[int, ...], List[_WorkerHandle]] = {}
         self.total_resources[f"node:{self.hex}"] = 1.0
         self.available: Dict[str, float] = dict(self.total_resources)
-        self.labels = dict(labels or {})
+        self.labels = {**accelerators.node_tpu_labels(), **(labels or {})}
         self.session_dir = session_dir or f"/tmp/ray_tpu/{os.getpid()}"
         os.makedirs(self.session_dir, exist_ok=True)
         self.store = ShmObjectStore(
@@ -187,6 +198,12 @@ class NodeAgent:
         if w in self._idle_workers:
             self._idle_workers.remove(w)
         logger.warning("worker %s died (state=%s)", w.worker_id[:8], prev_state)
+        if w.tpu_chips is not None:
+            self._return_chips(w.tpu_chips)
+            pool = self._tpu_idle.get(w.tpu_chips)
+            if pool and w in pool:
+                pool.remove(w)
+            w.tpu_chips = None
         if w.client_holder:
             try:
                 await self.gcs.call("drop_holder", holder=w.client_holder)
@@ -206,7 +223,7 @@ class NodeAgent:
                 pass
 
     # ----------------------------------------------------------- worker pool
-    async def _spawn_worker(self) -> _WorkerHandle:
+    async def _spawn_worker(self, tpu_chips: Optional[Tuple[int, ...]] = None) -> _WorkerHandle:
         import uuid
 
         worker_id = uuid.uuid4().hex
@@ -215,10 +232,23 @@ class NodeAgent:
         env["RAY_TPU_AGENT_ADDR"] = self.rpc.address
         env["RAY_TPU_GCS_ADDR"] = self.gcs_address
         env["RAY_TPU_NODE_ID"] = self.hex
-        # workers must not grab the TPU chip by default; tasks that need the
-        # chip get TPU resources and unset this (round-2: per-chip VISIBLE
-        # masking like the reference's TPU_VISIBLE_CHIPS, tpu.py:155-195)
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        if tpu_chips is not None:
+            # dedicated TPU worker: sees exactly its chip subset
+            # (accelerators.py visible_chip_env, reference tpu.py:155-195)
+            from ray_tpu.core import accelerators
+
+            if not os.environ.get(accelerators.FAKE_CHIPS_ENV):
+                # real chips: let jax find the TPU backend (fake-chip test
+                # clusters keep the CPU backend)
+                env.pop("JAX_PLATFORMS", None)
+            for k in (accelerators.TPU_VISIBLE_CHIPS_ENV,
+                      accelerators.TPU_CHIPS_PER_HOST_BOUNDS_ENV,
+                      accelerators.TPU_HOST_BOUNDS_ENV):
+                env.pop(k, None)
+            env.update(accelerators.visible_chip_env(list(tpu_chips), self._total_chips))
+        else:
+            # CPU workers must not grab the TPU chip
+            env.setdefault("JAX_PLATFORMS", "cpu")
         logfile = open(os.path.join(self.session_dir, f"worker-{worker_id[:8]}.log"), "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.node.worker_main"],
@@ -226,8 +256,98 @@ class NodeAgent:
             cwd=os.getcwd(),
         )
         handle = _WorkerHandle(proc, worker_id)
+        handle.tpu_chips = tpu_chips
         self._workers[worker_id] = handle
         return handle
+
+    # ------------------------------------------------------- TPU chip leasing
+    def _valid_chip_count(self, n: int) -> bool:
+        """Partial-host chip subsets have known-good libtpu bounds only for
+        1, 2 and 4 chips (accelerators.visible_chip_env); whole-host always
+        works (framework defaults)."""
+        return n == self._total_chips or n in (1, 2, 4)
+
+    # Invariant: every chip id is in EXACTLY ONE place — self._free_chips, or
+    # the .tpu_chips of one live worker handle. Workers own their chips from
+    # spawn to death (_on_worker_death returns them); nothing else does.
+    def _take_chips(self, n: int) -> Optional[Tuple[int, ...]]:
+        """Assign n concrete chip ids from the free pool, reclaiming (killing)
+        idle dedicated workers when the pool runs short — availability
+        accounting already guarantees n <= total unleased."""
+        if len(self._free_chips) < n:
+            for key, idles in list(self._tpu_idle.items()):
+                while idles and len(self._free_chips) < n:
+                    w = idles.pop()
+                    if w.state != "IDLE":
+                        continue  # leased/racing: not reclaimable, just unlist
+                    self._kill_worker(w)
+                    if w.tpu_chips is not None:
+                        self._return_chips(w.tpu_chips)
+                        w.tpu_chips = None
+                if not idles:
+                    self._tpu_idle.pop(key, None)
+                if len(self._free_chips) >= n:
+                    break
+        if len(self._free_chips) < n:
+            return None
+        chips = tuple(sorted(self._free_chips[:n]))
+        self._free_chips = self._free_chips[n:]
+        return chips
+
+    def _return_chips(self, chips: Tuple[int, ...]) -> None:
+        self._free_chips.extend(chips)
+
+    def _kill_worker(self, w: _WorkerHandle) -> None:
+        """Kill + deregister so _supervise_loop/_on_worker_death never sees it
+        (the caller handles chip return exactly once)."""
+        w.state = "DEAD"
+        self._workers.pop(w.worker_id, None)
+        try:
+            w.proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def _lease_tpu_worker(self, n: int) -> _WorkerHandle:
+        """Lease a dedicated worker for n chips: exact-size warm reuse first
+        (libtpu init is seconds on real chips), else spawn on freshly
+        assigned chip ids. Owns the whole chip lifecycle on failure."""
+        for key, idles in self._tpu_idle.items():
+            if len(key) != n:
+                continue
+            while idles:
+                w = idles.pop()
+                if w.proc.poll() is None and w.state == "IDLE":
+                    w.state = "LEASED"
+                    return w
+        chips = self._take_chips(n)
+        if chips is None:
+            raise TimeoutError("TPU chips unavailable")
+        w = await self._spawn_worker(tpu_chips=chips)
+        deadline = time.monotonic() + config.worker_start_timeout_s
+        try:
+            while not w.ready.is_set():
+                if w.proc.poll() is not None:
+                    raise TimeoutError(f"TPU worker exited with {w.proc.returncode}")
+                if time.monotonic() > deadline:
+                    raise TimeoutError("timed out waiting for TPU worker")
+                await asyncio.sleep(0.02)
+        except TimeoutError:
+            self._kill_worker(w)
+            self._return_chips(chips)
+            w.tpu_chips = None
+            raise
+        w.state = "LEASED"
+        pool = self._tpu_idle.get(w.tpu_chips)
+        if pool and w in pool:  # worker_ready parked it; we own it now
+            pool.remove(w)
+        return w
+
+    def _release_tpu_worker(self, w: _WorkerHandle) -> None:
+        if w.proc.poll() is None and w.tpu_chips is not None:
+            w.state = "IDLE"
+            pool = self._tpu_idle.setdefault(w.tpu_chips, [])
+            if w not in pool:
+                pool.append(w)
 
     async def rpc_worker_ready(self, worker_id: str, address: str,
                                client_holder: str = "") -> bool:
@@ -243,7 +363,16 @@ class NodeAgent:
         w.client = await RpcClient(address).connect()
         w.state = "IDLE"
         w.ready.set()
-        self._idle_workers.append(w)
+        if w.tpu_chips is None:
+            self._idle_workers.append(w)
+        else:
+            # dedicated TPU worker: park in the chip-keyed pool so a worker
+            # whose original lease timed out is reusable/reclaimable instead
+            # of orphaned with its chips. A waiting _lease_tpu_worker grabs
+            # it right after (state -> LEASED) and reuse skips non-IDLE.
+            pool = self._tpu_idle.setdefault(w.tpu_chips, [])
+            if w not in pool:
+                pool.append(w)
         return True
 
     async def _lease_worker(self, timeout: Optional[float] = None) -> _WorkerHandle:
@@ -803,9 +932,23 @@ class NodeAgent:
                 token = self._acquire_for_spec(spec)
         if token is None:
             return {"ok": False, "retryable": True, "reason": "busy", "error": "resources busy"}
-        # 3. worker lease + push
+        # 3. worker lease + push. Tasks holding TPU resources run on a
+        # DEDICATED worker that sees exactly its assigned chip subset
+        # (TPU_VISIBLE_CHIPS); CPU tasks use the shared pool.
+        tpu_need = int((spec.get("resources") or {}).get("TPU", 0))
+        if tpu_need > 0 and not self._valid_chip_count(tpu_need):
+            self._release_token(token)
+            await self._store_error(
+                spec,
+                f"TPU count {tpu_need} is not a valid chip subset on a "
+                f"{self._total_chips}-chip host (valid: 1, 2, 4, or all chips)",
+            )
+            return {"ok": False, "retryable": False, "error": "invalid TPU count"}
         try:
-            w = await self._lease_worker()
+            if tpu_need > 0:
+                w = await self._lease_tpu_worker(tpu_need)
+            else:
+                w = await self._lease_worker()
         except TimeoutError as e:
             self._release_token(token)
             return {"ok": False, "retryable": True, "reason": "busy", "error": str(e)}
@@ -824,7 +967,10 @@ class NodeAgent:
             else:
                 w.blocked = False  # resources already released at block time
             w.lease_token = None
-            self._release_worker(w)
+            if w.tpu_chips is not None:
+                self._release_tpu_worker(w)
+            else:
+                self._release_worker(w)
 
     def _try_acquire(self, resources: Dict[str, float], dry_run: bool = False) -> bool:
         for k, v in resources.items():
@@ -946,8 +1092,20 @@ class NodeAgent:
         token = self._acquire_for_spec(spec)
         if token is None:
             return {"ok": False, "retryable": True, "reason": "busy", "error": "resources busy"}
+        tpu_need = int((spec.get("resources") or {}).get("TPU", 0))
+        if tpu_need > 0 and not self._valid_chip_count(tpu_need):
+            self._release_token(token)
+            await self._store_error(
+                spec,
+                f"TPU count {tpu_need} is not a valid chip subset on a "
+                f"{self._total_chips}-chip host (valid: 1, 2, 4, or all chips)",
+            )
+            return {"ok": False, "retryable": False, "error": "invalid TPU count"}
         try:
-            w = await self._lease_worker()
+            if tpu_need > 0:
+                w = await self._lease_tpu_worker(tpu_need)
+            else:
+                w = await self._lease_worker()
         except TimeoutError as e:
             self._release_token(token)
             return {"ok": False, "retryable": True, "error": str(e)}
@@ -965,9 +1123,15 @@ class NodeAgent:
             # constructor raised: creation error object stored by worker
             self._release_token(token)
             w._actor_token = None
-            w.state = "IDLE"
             w.actor_id = None
-            self._idle_workers.append(w)
+            if w.tpu_chips is not None:
+                # dedicated worker returns to the chip-keyed pool (NEVER the
+                # CPU pool: it would run CPU tasks with a TPU env and strand
+                # its chips forever)
+                self._release_tpu_worker(w)
+            else:
+                w.state = "IDLE"
+                self._idle_workers.append(w)
             return {"ok": False, "retryable": False, "error": result.get("error", "")}
         await self.gcs.call(
             "actor_started", actor_id=spec["actor_id"], node_id=self.hex, address=w.address

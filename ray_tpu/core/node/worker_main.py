@@ -84,7 +84,18 @@ class WorkerProcess:
             "worker_ready", worker_id=self.worker_id, address=self.rpc.address,
             client_holder=runtime.client_id,
         )
+        asyncio.ensure_future(self._agent_watchdog())
         logger.info("worker %s ready at %s", self.worker_id[:8], self.rpc.address)
+
+    async def _agent_watchdog(self) -> None:
+        """Die with the node agent (reference: workers exit when their raylet
+        goes away) — otherwise SIGKILLed agents orphan worker processes that
+        accumulate and saturate the host."""
+        while True:
+            await asyncio.sleep(2.0)
+            if self.agent is not None and self.agent._closed:  # noqa: SLF001
+                logger.warning("agent connection lost; worker exiting")
+                os._exit(0)
 
     # ----------------------------------------------------------- helpers
     def _load_function(self, function_id: str) -> Any:

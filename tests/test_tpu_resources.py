@@ -1,0 +1,126 @@
+"""TPU slice resource model + topology-aware gang scheduling.
+
+Reference analogue: python/ray/_private/accelerators/tpu.py (chip detection,
+TPU_VISIBLE_CHIPS recipe, TPU-{type}-head slice resources) and slice-aware
+placement-group semantics.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.core import accelerators
+from ray_tpu.core.rpc import SyncRpcClient
+
+
+# ------------------------------------------------------------ unit: detection
+def test_accelerator_env_model(monkeypatch):
+    monkeypatch.setenv(accelerators.FAKE_CHIPS_ENV, "4")
+    monkeypatch.setenv("RAY_TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    monkeypatch.setenv("RAY_TPU_SLICE_NAME", "slice-a")
+    monkeypatch.setenv("RAY_TPU_TPU_WORKER_ID", "0")
+    assert accelerators.detect_num_chips() == 4
+    assert accelerators.accelerator_type() == "v5e-8"
+    labels = accelerators.node_tpu_labels()
+    assert labels[accelerators.SLICE_LABEL] == "slice-a"
+    assert labels[accelerators.ACCEL_LABEL] == "v5e-8"
+    res = accelerators.node_tpu_resources()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v5e-8-head"] == 1.0
+    # non-head workers of the slice carry no head resource
+    monkeypatch.setenv("RAY_TPU_TPU_WORKER_ID", "1")
+    assert "TPU-v5e-8-head" not in accelerators.node_tpu_resources()
+
+
+def test_visible_chip_env_recipe():
+    assert accelerators.visible_chip_env([0, 1, 2, 3], 4) == {}  # full host
+    one = accelerators.visible_chip_env([2], 4)
+    assert one[accelerators.TPU_VISIBLE_CHIPS_ENV] == "2"
+    assert one[accelerators.TPU_CHIPS_PER_HOST_BOUNDS_ENV] == "1,1,1"
+    two = accelerators.visible_chip_env([0, 1], 4)
+    assert two[accelerators.TPU_VISIBLE_CHIPS_ENV] == "0,1"
+    assert two[accelerators.TPU_CHIPS_PER_HOST_BOUNDS_ENV] == "1,2,1"
+
+
+# --------------------------------------------------- cluster: chips + slices
+@pytest.fixture(scope="module")
+def tpu_cluster():
+    os.environ[accelerators.FAKE_CHIPS_ENV] = "4"
+    os.environ["RAY_TPU_ACCELERATOR_TYPE"] = "v5e-8"
+    os.environ["RAY_TPU_SLICE_NAME"] = "slice-a"
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        ray_tpu.init(address=c.gcs_address)
+        yield c
+        ray_tpu.shutdown()
+        c.shutdown()
+    finally:
+        for k in (accelerators.FAKE_CHIPS_ENV, "RAY_TPU_ACCELERATOR_TYPE", "RAY_TPU_SLICE_NAME"):
+            os.environ.pop(k, None)
+
+
+def test_tpu_resources_registered(tpu_cluster):
+    nodes = ray_tpu.nodes()
+    head = nodes[0]
+    assert head["Resources"].get("TPU") == 4.0
+    assert head["Resources"].get("TPU-v5e-8-head") == 1.0
+    assert head["Labels"][accelerators.SLICE_LABEL] == "slice-a"
+
+
+def test_tpu_task_gets_visible_chips(tpu_cluster):
+    @ray_tpu.remote(num_tpus=1)
+    def probe():
+        return {
+            "visible": os.environ.get(accelerators.TPU_VISIBLE_CHIPS_ENV),
+            "bounds": os.environ.get(accelerators.TPU_CHIPS_PER_HOST_BOUNDS_ENV),
+        }
+
+    out = ray_tpu.get(probe.remote(), timeout=120)
+    assert out["visible"] is not None and len(out["visible"].split(",")) == 1
+    assert out["bounds"] == "1,1,1"
+
+
+def test_two_tpu_tasks_get_distinct_chips(tpu_cluster):
+    import time
+
+    @ray_tpu.remote(num_tpus=2)
+    def probe(delay):
+        time.sleep(delay)
+        return os.environ.get(accelerators.TPU_VISIBLE_CHIPS_ENV)
+
+    a, b = ray_tpu.get([probe.remote(0.4), probe.remote(0.4)], timeout=120)
+    assert a is not None and b is not None
+    assert set(a.split(",")).isdisjoint(set(b.split(","))), (a, b)
+
+
+def test_strict_pack_prefers_same_slice(tpu_cluster):
+    """Two extra nodes share slice-b, one sits on slice-c; a 2-bundle
+    STRICT_PACK gang that cannot fit on one node must land entirely on
+    slice-b (same ICI domain), never straddle slices."""
+    os.environ["RAY_TPU_SLICE_NAME"] = "slice-b"
+    n1 = tpu_cluster.add_node(num_cpus=1)
+    n2 = tpu_cluster.add_node(num_cpus=1)
+    os.environ["RAY_TPU_SLICE_NAME"] = "slice-c"
+    n3 = tpu_cluster.add_node(num_cpus=1)
+    os.environ["RAY_TPU_SLICE_NAME"] = "slice-a"
+    tpu_cluster.wait_for_nodes(4)
+
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=30)
+
+    gcs = SyncRpcClient(tpu_cluster.gcs_address)
+    try:
+        info = gcs.call("placement_group_info", pg_id=pg.id.hex())
+        nodes = {n["NodeID"]: n["Labels"].get(accelerators.SLICE_LABEL)
+                 for n in gcs.call("get_nodes")}
+    finally:
+        gcs.close()
+    slices = {nodes[n] for n in info["placement"]}
+    assert len(slices) == 1, f"STRICT_PACK straddled slices: {slices}"
+    remove_placement_group(pg)
+    for n in (n1, n2, n3):
+        tpu_cluster.remove_node(n)
