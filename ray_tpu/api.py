@@ -48,6 +48,13 @@ def init(
         from ray_tpu.core.config import config
 
         config.apply_overrides(system_config)
+        if address is None:
+            # submitted jobs (and `ray_tpu start` shells) export the cluster
+            # address; init() then auto-connects like the reference's
+            # RAY_ADDRESS behavior
+            import os
+
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
         if address in (None, "local"):
             from ray_tpu.core.local_runtime import LocalRuntime
 

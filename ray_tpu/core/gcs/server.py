@@ -685,6 +685,20 @@ class GcsServer:
             rec["locations"].discard(node_id)
         return True
 
+    async def rpc_list_objects(self, limit: int = 1000) -> List[Dict[str, Any]]:
+        out = []
+        for object_id, rec in self.objects.items():
+            out.append({
+                "object_id": object_id,
+                "size": rec["size"],
+                "locations": sorted(rec["locations"]),
+                "holders": len(self.object_holders.get(object_id, ())),
+                "has_lineage": object_id in self.lineage,
+            })
+            if len(out) >= limit:
+                break
+        return out
+
     async def rpc_lookup_object(self, object_id: str) -> Optional[Dict[str, Any]]:
         rec = self.objects.get(object_id)
         if rec is None:

@@ -118,6 +118,8 @@ class NodeAgent:
         self._sched_drainer: Optional[asyncio.Task] = None
         # task_id -> lifecycle state (observability; state API reads this)
         self._task_states: Dict[str, str] = {}
+        # job_id -> {proc, log, entrypoint, started} (job supervisor)
+        self._jobs: Dict[str, Dict[str, Any]] = {}
         self._max_workers = max(1, int(ncpus))
         self._shutting_down = False
         # committed placement-group bundle reservations living on THIS node:
@@ -1166,6 +1168,130 @@ class NodeAgent:
 
     async def rpc_task_states(self) -> Dict[str, str]:
         return dict(self._task_states)
+
+    # ------------------------------------------------------------------- jobs
+    # Driver-script job submission (reference capability:
+    # dashboard/modules/job/sdk.py:35 submit_job:125 — here the head agent
+    # doubles as the job supervisor; job metadata mirrors into GCS KV so any
+    # client can query status/logs cluster-wide).
+    async def rpc_submit_job(
+        self,
+        entrypoint: str,
+        env: Optional[Dict[str, str]] = None,
+        working_dir: Optional[str] = None,
+        job_id: Optional[str] = None,
+    ) -> str:
+        import shlex
+        import uuid as _uuid
+
+        if not entrypoint.strip():
+            raise ValueError("empty job entrypoint")
+        job_id = job_id or f"job-{_uuid.uuid4().hex[:10]}"
+        log_path = os.path.join(self.session_dir, f"{job_id}.log")
+        jenv = dict(os.environ)
+        jenv.update(env or {})
+        jenv["RAY_TPU_ADDRESS"] = self.gcs_address
+        jenv.setdefault("JAX_PLATFORMS", "cpu")
+        with open(log_path, "ab") as logfile:  # child keeps its own dup
+            proc = subprocess.Popen(
+                shlex.split(entrypoint), env=jenv, stdout=logfile,
+                stderr=subprocess.STDOUT, cwd=working_dir or os.getcwd(),
+                start_new_session=True,
+            )
+        self._jobs[job_id] = {"proc": proc, "log": log_path,
+                              "entrypoint": entrypoint, "started": time.time()}
+        await self._publish_job(job_id, "RUNNING")
+        asyncio.ensure_future(self._watch_job(job_id))
+        return job_id
+
+    async def _watch_job(self, job_id: str) -> None:
+        rec = self._jobs[job_id]
+        proc: subprocess.Popen = rec["proc"]
+        while proc.poll() is None:
+            await asyncio.sleep(0.2)
+        rec["returncode"] = proc.returncode
+        if rec.get("stop_requested"):
+            status = "STOPPED"
+        else:
+            status = "SUCCEEDED" if proc.returncode == 0 else "FAILED"
+        await self._publish_job(job_id, status, retries=30)
+
+    async def _publish_job(self, job_id: str, status: str, retries: int = 3) -> None:
+        import json
+
+        rec = self._jobs.get(job_id, {})
+        meta = {
+            "job_id": job_id,
+            "status": status,
+            "node_id": self.hex,
+            "entrypoint": rec.get("entrypoint", ""),
+            "returncode": rec.get("returncode"),
+            "started": rec.get("started"),
+        }
+        for attempt in range(max(retries, 1)):
+            try:
+                await self.gcs.call("kv_put", key=f"job:{job_id}",
+                                    value=json.dumps(meta).encode())
+                return
+            except Exception:  # noqa: BLE001
+                if attempt == max(retries, 1) - 1:
+                    logger.exception("failed to publish job status")
+                else:
+                    await asyncio.sleep(1.0)
+
+    async def rpc_job_logs(self, job_id: str, tail_bytes: int = 65536,
+                           offset: Optional[int] = None) -> Any:
+        """tail mode (offset=None): last tail_bytes as raw bytes.
+        stream mode (offset=N): {"data": bytes-from-N, "offset": new-end} so
+        followers track an absolute position instead of a sliding tail."""
+        rec = self._jobs.get(job_id)
+        if rec is None:
+            raise KeyError(f"unknown job {job_id}")
+        if offset is None:
+            return self._read_log_tail(rec["log"], tail_bytes)
+        try:
+            with open(rec["log"], "rb") as f:
+                f.seek(offset)
+                data = f.read(tail_bytes)
+                return {"data": data, "offset": offset + len(data)}
+        except OSError:
+            return {"data": b"", "offset": offset}
+
+    async def rpc_stop_job(self, job_id: str) -> bool:
+        rec = self._jobs.get(job_id)
+        if rec is None:
+            return False
+        rec["stop_requested"] = True
+        proc: subprocess.Popen = rec["proc"]
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), 15)
+            except Exception:  # noqa: BLE001
+                proc.terminate()
+        return True
+
+    @staticmethod
+    def _read_log_tail(path: str, tail_bytes: int) -> bytes:
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read()
+        except OSError:
+            return b""
+
+    async def rpc_get_log(self, name: str, tail_bytes: int = 65536) -> bytes:
+        """Read a log file from this node's session dir by BASENAME only
+        (no path traversal)."""
+        base = os.path.basename(name)
+        return self._read_log_tail(os.path.join(self.session_dir, base), tail_bytes)
+
+    async def rpc_list_logs(self) -> List[str]:
+        try:
+            return sorted(f for f in os.listdir(self.session_dir) if f.endswith(".log"))
+        except OSError:
+            return []
 
     async def rpc_node_info(self) -> Dict[str, Any]:
         return {

@@ -1,0 +1,3 @@
+from ray_tpu.job.sdk import JobStatus, JobSubmissionClient
+
+__all__ = ["JobSubmissionClient", "JobStatus"]

@@ -1,0 +1,288 @@
+"""Operator CLI: ``python -m ray_tpu <command>``.
+
+Reference capability: python/ray/scripts/scripts.py:2592-2652 (``ray start/
+stop/status``) + the state/job CLIs. Session bookkeeping lives in
+``~/.ray_tpu/session`` (JSON: gcs address + process-group ids) so ``stop``
+can tear down what ``start`` launched.
+
+Commands:
+    start --head [--num-cpus N] [--num-tpus N] [--port P] [--resources k=v]
+    start --address HOST:PORT [--num-cpus N] ...      (join existing cluster)
+    stop
+    status
+    list nodes|actors|objects|tasks|jobs|pgs
+    submit [--working-dir D] [--no-wait] -- CMD...
+    logs JOB_ID [--follow]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+SESSION_FILE = os.path.expanduser("~/.ray_tpu/session")
+
+
+def _load_session() -> Optional[Dict[str, Any]]:
+    try:
+        with open(SESSION_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _save_session(data: Dict[str, Any]) -> None:
+    """Merge with any existing session so a second `start` on the same
+    machine (head + worker) doesn't orphan the first node's processes."""
+    prev = _load_session() or {}
+    data = dict(data)
+    data["pids"] = prev.get("pids", []) + data.get("pids", [])
+    data.setdefault("gcs_address", prev.get("gcs_address"))
+    os.makedirs(os.path.dirname(SESSION_FILE), exist_ok=True)
+    with open(SESSION_FILE, "w") as f:
+        json.dump(data, f)
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr:
+        sess = _load_session()
+        addr = sess["gcs_address"] if sess else None
+    if not addr:
+        sys.exit("no cluster address: pass --address, set RAY_TPU_ADDRESS, "
+                 "or run `ray_tpu start --head` first")
+    return addr
+
+
+def _wait_ready(path: str, proc: subprocess.Popen, what: str, timeout=40.0) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            content = open(path).read().strip()
+            if content:
+                return content
+        if proc.poll() is not None:
+            sys.exit(f"{what} exited with {proc.returncode}")
+        time.sleep(0.05)
+    sys.exit(f"{what} did not become ready in {timeout}s")
+
+
+def cmd_start(args) -> None:
+    session_dir = args.session_dir or f"/tmp/ray_tpu/session-{uuid.uuid4().hex[:8]}"
+    os.makedirs(session_dir, exist_ok=True)
+    env = dict(os.environ)
+    env["RAY_TPU_SESSION_DIR"] = session_dir
+    procs: List[int] = []
+
+    if args.head:
+        ready = os.path.join(session_dir, "gcs.ready")
+        gcs_log = open(os.path.join(session_dir, "gcs.log"), "ab")
+        cmd = [sys.executable, "-m", "ray_tpu.core.gcs.server", "--ready-file", ready]
+        if args.port:
+            cmd += ["--port", str(args.port)]
+        gcs = subprocess.Popen(cmd, env=env, stdout=gcs_log,
+                               stderr=subprocess.STDOUT, start_new_session=True)
+        gcs_address = _wait_ready(ready, gcs, "GCS")
+        procs.append(gcs.pid)
+    else:
+        gcs_address = _resolve_address(args)
+
+    ready = os.path.join(session_dir, f"agent-{uuid.uuid4().hex[:6]}.ready")
+    agent_log = open(os.path.join(session_dir, "agent.log"), "ab")
+    cmd = [
+        sys.executable, "-m", "ray_tpu.core.node.agent",
+        "--gcs", gcs_address, "--session-dir", session_dir,
+        "--ready-file", ready,
+    ]
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus:
+        cmd += ["--num-tpus", str(args.num_tpus)]
+    for kv in args.resources or []:
+        cmd += ["--resource", kv]
+    for kv in args.labels or []:
+        cmd += ["--label", kv]
+    if args.head:
+        cmd += ["--head"]
+    agent = subprocess.Popen(cmd, env=env, stdout=agent_log,
+                             stderr=subprocess.STDOUT, start_new_session=True)
+    _wait_ready(ready, agent, "node agent")
+    procs.append(agent.pid)
+
+    _save_session({"gcs_address": gcs_address, "pids": procs,
+                   "session_dir": session_dir})
+    role = "head" if args.head else "worker"
+    print(f"started {role} node; GCS at {gcs_address}")
+    print(f"session dir: {session_dir}")
+    if args.head:
+        print(f'connect with: ray_tpu.init(address="{gcs_address}") '
+              f"or RAY_TPU_ADDRESS={gcs_address}")
+
+
+def cmd_stop(_args) -> None:
+    sess = _load_session()
+    if not sess:
+        print("no session on record")
+        return
+    for pid in reversed(sess.get("pids", [])):
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+            print(f"killed process group {pid}")
+        except ProcessLookupError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            print(f"failed to kill {pid}: {e}")
+    try:
+        os.unlink(SESSION_FILE)
+    except OSError:
+        pass
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+
+
+def cmd_status(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    s = state.cluster_summary()
+    print(f"nodes alive:     {s['nodes']}")
+    total, avail = s["resources_total"], s["resources_available"]
+    for k in sorted(total):
+        if k.startswith("node:"):
+            continue
+        print(f"  {k:<20} {avail.get(k, 0.0):.1f} / {total[k]:.1f}")
+    d = s["debug"]
+    print(f"actors: {d['actors']}  objects: {d['objects']}  "
+          f"pgs: {d['pgs']}  tracked refs: {d['tracked_refs']}")
+    print(f"gcs uptime: {d['uptime_s']:.0f}s")
+
+
+def cmd_list(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    what = args.what
+    rows: Any
+    if what == "nodes":
+        rows = state.list_nodes()
+    elif what == "actors":
+        rows = state.list_actors()
+    elif what == "objects":
+        rows = state.list_objects()
+    elif what == "tasks":
+        rows = state.list_tasks()
+    elif what == "jobs":
+        rows = state.list_jobs()
+    elif what == "pgs":
+        rows = state.list_placement_groups()
+    else:  # pragma: no cover - argparse restricts choices
+        sys.exit(f"unknown listing {what}")
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def _stream_job_logs(client, job_id: str) -> str:
+    """Follow a job's log via absolute offsets (a sliding tail would stop
+    advancing past the tail window) until it reaches a terminal status.
+    Returns the final status."""
+    from ray_tpu.job.sdk import JobStatus
+
+    offset = 0
+    while True:
+        status = client.get_job_status(job_id)
+        while True:
+            text, offset = client.read_job_logs_from(job_id, offset)
+            if not text:
+                break
+            sys.stdout.write(text)
+            sys.stdout.flush()
+        if status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+            return status
+        time.sleep(0.3)
+
+
+def cmd_submit(args) -> None:
+    from ray_tpu.job.sdk import JobStatus, JobSubmissionClient
+
+    if not args.cmd or not " ".join(args.cmd).strip():
+        sys.exit("usage: ray_tpu submit [options] -- CMD [ARGS...]")
+    client = JobSubmissionClient(_resolve_address(args))
+    entrypoint = " ".join(args.cmd)
+    job_id = client.submit_job(entrypoint, working_dir=args.working_dir)
+    print(f"submitted {job_id}: {entrypoint}")
+    if args.no_wait:
+        return
+    status = _stream_job_logs(client, job_id)
+    print(f"\njob {job_id}: {status}")
+    sys.exit(0 if status == JobStatus.SUCCEEDED else 1)
+
+
+def cmd_logs(args) -> None:
+    from ray_tpu.job.sdk import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    if not args.follow:
+        sys.stdout.write(client.get_job_logs(args.job_id))
+        return
+    _stream_job_logs(client, args.job_id)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None, help="GCS address to join")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=0)
+    p.add_argument("--resources", action="append", default=[])
+    p.add_argument("--labels", action="append", default=[])
+    p.add_argument("--session-dir", default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop nodes started on this machine")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster summary")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("what", choices=["nodes", "actors", "objects", "tasks", "jobs", "pgs"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("submit", help="submit a driver script as a job")
+    p.add_argument("--address", default=None)
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("--no-wait", action="store_true")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="entrypoint, e.g. -- python train.py")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("logs", help="fetch or follow job logs")
+    p.add_argument("job_id")
+    p.add_argument("--address", default=None)
+    p.add_argument("--follow", action="store_true")
+    p.set_defaults(fn=cmd_logs)
+
+    args = parser.parse_args(argv)
+    if getattr(args, "cmd", None) and args.cmd and args.cmd[0] == "--":
+        args.cmd = args.cmd[1:]
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

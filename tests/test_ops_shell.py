@@ -1,0 +1,179 @@
+"""Operator shell: CLI start/status/stop, state API, job submission.
+
+Reference analogues: scripts/scripts.py (ray start/stop/status),
+util/state/api.py, dashboard/modules/job/sdk.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.job.sdk import JobStatus, JobSubmissionClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(*args, env=None, timeout=120):
+    e = dict(os.environ)
+    e.update(env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=e, cwd=REPO,
+    )
+
+
+@pytest.fixture(scope="module")
+def cli_cluster(tmp_path_factory):
+    """A cluster started through the CLI, like an operator would."""
+    home = tmp_path_factory.mktemp("home")
+    env = {"HOME": str(home), "JAX_PLATFORMS": "cpu"}
+    r = _cli("start", "--head", "--num-cpus", "2", env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    session = json.load(open(home / ".ray_tpu" / "session"))
+    address = session["gcs_address"]
+    yield address, env
+    _cli("stop", env=env)
+
+
+def test_cli_start_status_stop(cli_cluster):
+    address, env = cli_cluster
+    r = _cli("status", "--address", address, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "nodes alive:     1" in r.stdout
+    assert "CPU" in r.stdout
+
+
+def test_state_api_lists(cli_cluster):
+    address, env = cli_cluster
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    try:
+        from ray_tpu.util import state
+
+        @ray_tpu.remote
+        class Sentinel:
+            def ping(self):
+                return "pong"
+
+        s = Sentinel.options(name="state-sentinel").remote()
+        assert ray_tpu.get(s.ping.remote(), timeout=60) == "pong"
+        ref = ray_tpu.put({"state": "api"})
+
+        nodes = state.list_nodes()
+        assert any(n["Alive"] for n in nodes)
+        actors = state.list_actors()
+        assert any(a.get("name") == "state-sentinel" for a in actors)
+        objs = state.list_objects()
+        assert any(o["object_id"] == ref.id.hex() for o in objs)
+        tasks = state.list_tasks()
+        assert isinstance(tasks, list)
+        assert state.cluster_summary()["nodes"] >= 1
+        logs = state.list_logs()
+        assert any(name.endswith(".log") for name in logs)
+        # driver can read a node log without touching internals
+        assert isinstance(state.get_log(logs[0]), bytes)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_job_submission_roundtrip(cli_cluster, tmp_path):
+    address, env = cli_cluster
+    script = tmp_path / "job_script.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        import ray_tpu
+
+        ray_tpu.init()  # picks up RAY_TPU_ADDRESS from the job env
+
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        print("RESULT:", sum(ray_tpu.get([square.remote(i) for i in range(5)], timeout=120)))
+        ray_tpu.shutdown()
+    """ % REPO))
+
+    client = JobSubmissionClient(address)
+    try:
+        job_id = client.submit_job(f"{sys.executable} {script}")
+        status = client.wait_until_finished(job_id, timeout=180)
+        logs = client.get_job_logs(job_id)
+        assert status == JobStatus.SUCCEEDED, logs
+        assert "RESULT: 30" in logs
+        assert any(j["job_id"] == job_id for j in client.list_jobs())
+    finally:
+        client.close()
+
+
+def test_job_failure_reported(cli_cluster, tmp_path):
+    address, env = cli_cluster
+    script = tmp_path / "bad_job.py"
+    script.write_text("import sys; print('about to fail'); sys.exit(3)\n")
+    client = JobSubmissionClient(address)
+    try:
+        job_id = client.submit_job(f"{sys.executable} {script}")
+        status = client.wait_until_finished(job_id, timeout=60)
+        assert status == JobStatus.FAILED
+        info = client.get_job_info(job_id)
+        assert info["returncode"] == 3
+        assert "about to fail" in client.get_job_logs(job_id)
+    finally:
+        client.close()
+
+
+def test_job_stop_reports_stopped(cli_cluster, tmp_path):
+    address, env = cli_cluster
+    script = tmp_path / "sleepy_job.py"
+    script.write_text("import time; print('sleeping', flush=True); time.sleep(60)\n")
+    client = JobSubmissionClient(address)
+    try:
+        job_id = client.submit_job(f"{sys.executable} {script}")
+        time.sleep(1.0)
+        assert client.stop_job(job_id)
+        status = client.wait_until_finished(job_id, timeout=30)
+        assert status == JobStatus.STOPPED
+    finally:
+        client.close()
+
+
+def test_job_log_stream_past_tail_window(cli_cluster, tmp_path):
+    """Logs larger than the 64KiB tail window must stream completely via the
+    absolute-offset reader."""
+    address, env = cli_cluster
+    script = tmp_path / "chatty_job.py"
+    script.write_text(
+        "for i in range(3000):\n"
+        "    print(f'line-{i:05d} ' + 'x' * 40)\n"
+    )  # ~140KB of output
+    client = JobSubmissionClient(address)
+    try:
+        job_id = client.submit_job(f"{sys.executable} {script}")
+        client.wait_until_finished(job_id, timeout=120)
+        text, offset = "", 0
+        while True:
+            chunk, offset = client.read_job_logs_from(job_id, offset)
+            if not chunk:
+                break
+            text += chunk
+        assert "line-00000" in text and "line-02999" in text
+        assert len(text) > 100_000
+    finally:
+        client.close()
+
+
+def test_cli_submit_streams_logs(cli_cluster, tmp_path):
+    address, env = cli_cluster
+    script = tmp_path / "hello_job.py"
+    script.write_text("print('hello from the job')\n")
+    r = _cli("submit", "--address", address, "--",
+             sys.executable, str(script), env=env, timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "hello from the job" in r.stdout
+    assert "SUCCEEDED" in r.stdout
