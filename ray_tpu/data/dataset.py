@@ -135,8 +135,10 @@ class Dataset:
         return Dataset(source)
 
     # ----------------------------------------------------------- consumption
-    def _execute(self) -> Iterator[ObjectRef]:
-        return StreamingExecutor(self._stages).execute(self._source_fn())
+    def _execute(self, collect_rows: bool = False) -> Iterator[ObjectRef]:
+        executor = StreamingExecutor(self._stages, collect_rows=collect_rows)
+        self._last_executor = executor
+        return executor.execute(self._source_fn())
 
     def iter_internal_refs(self) -> Iterator[ObjectRef]:
         return self._execute()
@@ -272,7 +274,17 @@ class Dataset:
             pacsv.write_csv(ray_tpu.get(ref), f"{path}/part-{i:05d}.csv")
 
     def stats(self) -> str:
-        return f"Dataset(stages={[s.name for s in self._stages]})"
+        """Per-stage wall-time/blocks/rows of the LAST execution (runs the
+        pipeline with row collection if nothing has executed yet).
+        Reference: Dataset.stats() backed by _internal/stats.py."""
+        last = getattr(self, "_last_executor", None)
+        # blocks_out == 0 everywhere means an execution was CREATED but never
+        # consumed (stats are appended eagerly per stage) — run for real
+        if last is None or not any(st.blocks_out for st in last.stats):
+            for _ in self._execute(collect_rows=True):
+                pass
+            last = self._last_executor
+        return last.summary()
 
     def __repr__(self) -> str:
         return f"Dataset(num_stages={len(self._stages)})"

@@ -128,66 +128,185 @@ class MapStage(Stage):
                     pass
 
 
+def _exchange(inputs: Iterator[ObjectRef], num_outputs: Optional[int],
+              split_fn: Callable, reduce_fn: Callable) -> Iterator[ObjectRef]:
+    """Two-phase map/reduce exchange (reference: planner/exchange/
+    shuffle_task_scheduler): map tasks split every input block into
+    num_outputs partitions (refs only — block DATA never touches the
+    driver, so datasets larger than any one store spill instead of OOM);
+    reduce tasks combine partition j of every map output. Yields reduce
+    output refs as they finish."""
+    input_refs = list(inputs)
+    if not input_refs:
+        return
+    n_out = num_outputs or len(input_refs)
+
+    split_remote = ray_tpu.remote(num_returns=n_out, name="data::exchange_split")(
+        split_fn
+    ) if n_out > 1 else None
+
+    # map phase: one split task per input block -> n_out partition refs each
+    partitions: List[List[ObjectRef]] = []
+    for i, ref in enumerate(input_refs):
+        if n_out == 1:
+            partitions.append([ref])
+        else:
+            out = split_remote.remote(ref, n_out, i)
+            partitions.append(list(out) if isinstance(out, (list, tuple)) else [out])
+
+    reduce_remote = ray_tpu.remote(name="data::exchange_reduce")(reduce_fn)
+    reduce_refs = [
+        reduce_remote.remote(j, *[parts[j] for parts in partitions])
+        for j in range(n_out)
+    ]
+    for ref in reduce_refs:
+        ray_tpu.wait([ref], num_returns=1, timeout=None)
+        yield ref
+
+
 class RepartitionStage(Stage):
     def __init__(self, num_blocks: int):
         self.name = f"repartition({num_blocks})"
         self.num_blocks = num_blocks
 
     def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        from ray_tpu.data.block import BlockAccessor, concat_blocks
+        def split(block, n, _idx=0):
+            from ray_tpu.data.block import BlockAccessor
 
-        blocks = [ray_tpu.get(r) for r in inputs]
-        if not blocks:
-            return
-        combined = concat_blocks(blocks)
-        total = combined.num_rows
-        per = max(1, total // self.num_blocks)
-        acc = BlockAccessor(combined)
-        for i in range(self.num_blocks):
-            start = i * per
-            end = total if i == self.num_blocks - 1 else min((i + 1) * per, total)
-            if start >= total:
-                break
-            yield ray_tpu.put(acc.slice(start, end))
+            acc = BlockAccessor(block)
+            total = block.num_rows
+            per, rem = divmod(total, n)
+            outs, start = [], 0
+            for i in range(n):
+                end = start + per + (1 if i < rem else 0)
+                outs.append(acc.slice(start, end))
+                start = end
+            return tuple(outs) if n > 1 else outs[0]
+
+        def reduce(_j, *parts):
+            from ray_tpu.data.block import concat_blocks
+
+            return concat_blocks(list(parts))
+
+        yield from _exchange(inputs, self.num_blocks, split, reduce)
 
 
 class ShuffleStage(Stage):
-    """All-to-all random shuffle (reference: planner/exchange/ shuffle —
-    two-phase map/reduce; single-driver merge tier here, upgrade TODO)."""
+    """Distributed all-to-all random shuffle: rows scatter to random output
+    partitions in map tasks, reduce tasks permute within their partition.
+    No driver-side materialization (reference: planner/exchange/)."""
 
     def __init__(self, seed: Optional[int] = None):
         self.name = "random_shuffle"
         self.seed = seed
 
     def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        import numpy as np
+        seed = self.seed
 
-        from ray_tpu.data.block import BlockAccessor, concat_blocks
+        def split(block, n, idx=0):
+            import numpy as np
 
-        blocks = [ray_tpu.get(r) for r in inputs]
-        if not blocks:
-            return
-        combined = concat_blocks(blocks)
-        rng = np.random.default_rng(self.seed)
-        perm = rng.permutation(combined.num_rows)
-        shuffled = combined.take(perm)
-        n = max(1, len(blocks))
-        acc = BlockAccessor(shuffled)
-        per = max(1, shuffled.num_rows // n)
-        for i in range(n):
-            start = i * per
-            end = shuffled.num_rows if i == n - 1 else min((i + 1) * per, shuffled.num_rows)
-            if start >= shuffled.num_rows:
-                break
-            yield ray_tpu.put(acc.slice(start, end))
+            rng = np.random.default_rng(None if seed is None else seed + idx)
+            assign = rng.integers(0, n, block.num_rows)
+            outs = tuple(block.take(np.nonzero(assign == j)[0]) for j in range(n))
+            return outs if n > 1 else outs[0]
+
+        def reduce(j, *parts):
+            import numpy as np
+
+            from ray_tpu.data.block import concat_blocks
+
+            combined = concat_blocks(list(parts))
+            rng = np.random.default_rng(None if seed is None else seed + 10_000 + j)
+            return combined.take(rng.permutation(combined.num_rows))
+
+        yield from _exchange(inputs, None, split, reduce)
+
+
+class StageStats:
+    """Per-stage execution statistics (reference: _internal/stats.py
+    DatasetStats — wall time, block count, rows; collected at the stage
+    boundaries the executor already owns)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.wall_s = 0.0
+        self.blocks_out = 0
+        self.rows_out = 0
+
+    def row(self) -> Dict[str, Any]:
+        return {"stage": self.name, "wall_s": round(self.wall_s, 4),
+                "blocks": self.blocks_out, "rows": self.rows_out}
 
 
 class StreamingExecutor:
-    def __init__(self, stages: List[Stage]):
+    def __init__(self, stages: List[Stage], collect_rows: bool = False):
         self.stages = stages
+        self.stats: List[StageStats] = []
+        # row counting requires a driver-side metadata peek per block; off by
+        # default on the hot path, on for Dataset.stats() runs
+        self._collect_rows = collect_rows
+
+    def _wrap(self, stage: Stage, stream: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
+        import time as _time
+
+        st = StageStats(stage.name)
+        self.stats.append(st)
+
+        class _TimedUpstream:
+            """Accounts time spent pulling from upstream so a stage's wall_s
+            is ITS OWN work, not the cumulative pipeline time (pull-based
+            chains execute upstream inside downstream's next())."""
+
+            def __init__(self, it):
+                self.it = iter(it)
+                self.time_in_next = 0.0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                t0 = _time.perf_counter()
+                try:
+                    return next(self.it)
+                finally:
+                    self.time_in_next += _time.perf_counter() - t0
+
+        upstream = _TimedUpstream(stream)
+
+        def gen() -> Iterator[ObjectRef]:
+            it = stage.execute(upstream)
+            while True:
+                mark = upstream.time_in_next
+                t0 = _time.perf_counter()
+                try:
+                    ref = next(it)
+                except StopIteration:
+                    st.wall_s += (_time.perf_counter() - t0) - (
+                        upstream.time_in_next - mark)
+                    return
+                st.wall_s += (_time.perf_counter() - t0) - (
+                    upstream.time_in_next - mark)
+                st.blocks_out += 1
+                if self._collect_rows:
+                    try:
+                        st.rows_out += ray_tpu.get(ref).num_rows
+                    except Exception:  # noqa: BLE001
+                        pass
+                yield ref
+
+        return gen()
 
     def execute(self, source: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
         stream = source
         for stage in self.stages:
-            stream = stage.execute(stream)
+            stream = self._wrap(stage, stream)
         return stream
+
+    def summary(self) -> str:
+        lines = [f"{'stage':<28}{'wall_s':>10}{'blocks':>8}{'rows':>10}"]
+        for st in self.stats:
+            r = st.row()
+            lines.append(f"{r['stage']:<28}{r['wall_s']:>10}{r['blocks']:>8}"
+                         f"{r['rows'] if self._collect_rows else '-':>10}")
+        return "\n".join(lines)

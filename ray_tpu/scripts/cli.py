@@ -131,6 +131,19 @@ def cmd_stop(_args) -> None:
     if not sess:
         print("no session on record")
         return
+    # stop running jobs first: they live in their own process groups, so the
+    # pid kills below would otherwise orphan them against a dead cluster
+    try:
+        from ray_tpu.job.sdk import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient(sess["gcs_address"])
+        for job in client.list_jobs():
+            if job.get("status") == JobStatus.RUNNING:
+                client.stop_job(job["job_id"])
+                print(f"stopped job {job['job_id']}")
+        client.close()
+    except Exception:  # noqa: BLE001 - cluster may already be half-dead
+        pass
     for pid in reversed(sess.get("pids", [])):
         try:
             os.killpg(os.getpgid(pid), signal.SIGKILL)
@@ -214,10 +227,14 @@ def _stream_job_logs(client, job_id: str) -> str:
 def cmd_submit(args) -> None:
     from ray_tpu.job.sdk import JobStatus, JobSubmissionClient
 
+    import shlex
+
     if not args.cmd or not " ".join(args.cmd).strip():
         sys.exit("usage: ray_tpu submit [options] -- CMD [ARGS...]")
     client = JobSubmissionClient(_resolve_address(args))
-    entrypoint = " ".join(args.cmd)
+    # shlex.join: the agent re-splits with shlex.split, so argv boundaries
+    # (paths/args with spaces) must survive the round trip
+    entrypoint = shlex.join(args.cmd)
     job_id = client.submit_job(entrypoint, working_dir=args.working_dir)
     print(f"submitted {job_id}: {entrypoint}")
     if args.no_wait:

@@ -55,3 +55,14 @@ def test_many_small_objects_batched_get(small_store_cluster):
     assert dt < 30, f"batched get of 300 small objects took {dt:.1f}s"
 
 
+
+
+def test_shuffle_larger_than_store_spills(small_store_cluster):
+    """Distributed shuffle of a dataset larger than the 2MB object store:
+    block data never aggregates on the driver and the store spills instead
+    of failing (reference: test_object_spilling + exchange shuffle)."""
+    from ray_tpu import data as rd
+
+    # ~4MB of tensor rows across 8 blocks >> 2MB store
+    ds = rd.range_tensor(4096, shape=(128,), parallelism=8).random_shuffle(seed=3)
+    assert ds.count() == 4096

@@ -149,3 +149,20 @@ def test_pipeline_into_trainer(tmp_path):
     ).fit()
     assert result.error is None
     assert result.metrics["rows"] > 0
+
+
+def test_shuffle_deterministic_and_complete():
+    a = rd.range(500, parallelism=5).random_shuffle(seed=7).take_all()
+    b = rd.range(500, parallelism=5).random_shuffle(seed=7).take_all()
+    ids_a = [r["id"] for r in a]
+    assert sorted(ids_a) == list(range(500))          # nothing lost
+    assert ids_a != list(range(500))                  # actually shuffled
+    assert ids_a == [r["id"] for r in b]              # seed-deterministic
+
+
+def test_stats_reports_stages():
+    ds = rd.range(200, parallelism=4).map(lambda r: {"id": r["id"] + 1}).random_shuffle(seed=0)
+    assert ds.count() == 200
+    report = ds.stats()
+    assert "map" in report and "random_shuffle" in report
+    assert "wall_s" in report
