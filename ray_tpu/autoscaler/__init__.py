@@ -1,0 +1,5 @@
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = ["AutoscalerConfig", "StandardAutoscaler", "NodeProvider",
+           "LocalNodeProvider"]

@@ -10,6 +10,7 @@ Driver and worker processes both use this class; it speaks to:
 from __future__ import annotations
 
 import concurrent.futures
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -90,6 +91,7 @@ class ClusterRuntime(CoreRuntime):
         # without heartbeats the GCS would reap them after the lease)
         self._start_ref_flusher()
         self._exported_fns: set = set()
+        self._workdir_hashes: Dict[str, str] = {}
         self._actor_clients: Dict[str, SyncRpcClient] = {}
         self._actor_cache: Dict[str, Dict[str, Any]] = {}
         self._dispatchers: Dict[str, Any] = {}
@@ -278,9 +280,35 @@ class ClusterRuntime(CoreRuntime):
             self.gcs.call("kv_put", key=f"fn:{function_id}", value=cloudpickle.dumps(fn))
         self._exported_fns.add(function_id)
 
+    def _prepare_runtime_env(self, runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+        """Validate + canonicalize; package and upload working_dir to GCS KV
+        once per content hash (agents stage it on demand)."""
+        from ray_tpu.core import runtime_env as re_mod
+
+        env = re_mod.normalize(runtime_env)
+        internal = {k: v for k, v in (runtime_env or {}).items()
+                    if k.startswith("__")}
+        if not env:
+            return internal or None
+        if "working_dir" in env:
+            path = os.path.abspath(env.pop("working_dir"))
+            # package once per path per driver (contents are snapshotted at
+            # first use, like the reference's URI cache) — re-zipping a large
+            # tree on EVERY submit would dominate submit latency
+            content_hash = self._workdir_hashes.get(path)
+            if content_hash is None:
+                content_hash, payload = re_mod.package_working_dir(path)
+                key = re_mod.kv_key(content_hash)
+                if self.gcs.call("kv_get", key=key) is None:
+                    self.gcs.call("kv_put", key=key, value=payload)
+                self._workdir_hashes[path] = content_hash
+            env["working_dir_hash"] = content_hash
+        return {**env, **internal}
+
     def _spec_dict(self, spec: TaskSpec, args: tuple, kwargs: dict) -> Dict[str, Any]:
         payload, _refs = serialization.pack((args, kwargs))
         return {
+            "runtime_env": self._prepare_runtime_env(spec.runtime_env),
             "task_id": spec.task_id.binary().hex(),
             "name": spec.name,
             "function_id": spec.function.function_id,

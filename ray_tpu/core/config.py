@@ -121,6 +121,13 @@ _DEFINITIONS = [
     ("max_lineage_bytes", 8 * 1024 * 1024, int,
      "Task specs above this size are not retained for lineage reconstruction."),
     # --- scheduling ---
+    ("dispatch_unreachable_grace_s", 15.0, float,
+     "Re-place (without consuming task retries) when the dispatch target is "
+     "unreachable, for this long — covers the health-check lag after a node "
+     "dies or is scaled down."),
+    ("infeasible_task_grace_s", 120.0, float,
+     "How long a cluster-infeasible task stays pending (feeding the "
+     "autoscaler's demand signal) before erroring."),
     ("local_queue_wait_s", 0.5, float,
      "How long a task queues at a busy node before spilling back to global "
      "placement (the raylet local-queue analogue)."),

@@ -46,6 +46,8 @@ class GcsServer:
         # available resources per node (updated by heartbeats)
         self.available: Dict[str, Dict[str, float]] = {}
         self.last_heartbeat: Dict[str, float] = {}
+        # per-node load gauges from heartbeats (dispatching counts etc.)
+        self.node_load: Dict[str, Dict[str, Any]] = {}
         self.kv: Dict[str, bytes] = {}
         # actors: actor_id hex -> record
         self.actors: Dict[str, Dict[str, Any]] = {}
@@ -82,6 +84,11 @@ class GcsServer:
         self._gc_task: Optional[asyncio.Task] = None
         self._schedule_calls = 0  # batched RPCs received
         self._schedule_reqs = 0   # placement requests inside them
+        # req_id -> (last_seen, shape): resource requests that could not be
+        # placed — the autoscaler's demand signal. Keyed so a pending task
+        # retrying placement every 50ms counts ONCE, not once per retry
+        # (reference: resource_demand_scheduler's pending snapshot).
+        self._unmet_demand: Dict[str, Tuple[float, Dict[str, float]]] = {}
 
     async def start(self) -> Tuple[str, int]:
         host, port = await self.rpc.start()
@@ -135,6 +142,7 @@ class GcsServer:
         if node_id not in self.nodes:
             return False  # node must re-register (GCS restarted)
         self.available[node_id] = dict(available)
+        self.node_load[node_id] = dict(load or {})
         self.last_heartbeat[node_id] = time.monotonic()
         return True
 
@@ -242,8 +250,44 @@ class GcsServer:
         self._schedule_calls += 1
         self._schedule_reqs += len(requests)
         if self._external is not None:
-            return await self._external.schedule_batch(requests, self)
-        return [self._schedule_one(r) for r in requests]
+            placements = await self._external.schedule_batch(requests, self)
+        else:
+            placements = [self._schedule_one(r) for r in requests]
+        now = time.monotonic()
+        for i, (req, target) in enumerate(zip(requests, placements)):
+            rid = req.get("req_id") or f"anon:{self._schedule_reqs}:{i}"
+            if target is None:
+                self._unmet_demand[rid] = (now, dict(req.get("resources") or {}))
+            else:
+                self._unmet_demand.pop(rid, None)  # demand satisfied
+        if len(self._unmet_demand) > 10000:
+            for rid in list(self._unmet_demand)[:5000]:
+                self._unmet_demand.pop(rid, None)
+        return placements
+
+    async def rpc_autoscaler_state(self, window_s: float = 30.0) -> Dict[str, Any]:
+        """Demand + utilization snapshot for the autoscaler: recently-unmet
+        resource shapes and per-node availability."""
+        cutoff = time.monotonic() - window_s
+        self._unmet_demand = {
+            rid: (t, r) for rid, (t, r) in self._unmet_demand.items() if t >= cutoff
+        }
+        return {
+            "unmet_shapes": [r for _, r in self._unmet_demand.values()],
+            "nodes": {
+                n: {
+                    "alive": info["Alive"],
+                    "address": info["NodeManagerAddress"],
+                    "is_head": info.get("is_head", False),
+                    "total": info["Resources"],
+                    "available": self.available.get(n, {}),
+                    "load": self.node_load.get(n, {}),
+                    "last_heartbeat_age_s": time.monotonic()
+                    - self.last_heartbeat.get(n, 0.0),
+                }
+                for n, info in self.nodes.items()
+            },
+        }
 
     def _schedule_one(self, req: Dict[str, Any]) -> Optional[str]:
         resources = req.get("resources") or {}

@@ -51,6 +51,8 @@ class _WorkerHandle:
         self._actor_token: Optional[Tuple[str, Any, Dict[str, float]]] = None
         self.blocked = False
         self.tpu_chips: Optional[Tuple[int, ...]] = None  # dedicated chip subset
+        self.env_hash: str = ""          # runtime-env pool key
+        self.staged_cwd: Optional[str] = None
 
 
 class NodeAgent:
@@ -101,7 +103,9 @@ class NodeAgent:
         self.error_objects: Set[str] = set()
         self.gcs: Optional[RpcClient] = None
         self._workers: Dict[str, _WorkerHandle] = {}
-        self._idle_workers: List[_WorkerHandle] = []
+        # idle task-pool workers, keyed by runtime-env hash ("" = plain):
+        # envs never share worker processes (reference: pool env isolation)
+        self._idle_workers: Dict[str, List[_WorkerHandle]] = {}
         self._peer_clients: Dict[str, RpcClient] = {}
         self._peer_addr_cache: Dict[str, str] = {}
         self._hb_task: Optional[asyncio.Task] = None
@@ -120,6 +124,14 @@ class NodeAgent:
         self._task_states: Dict[str, str] = {}
         # job_id -> {proc, log, entrypoint, started} (job supervisor)
         self._jobs: Dict[str, Dict[str, Any]] = {}
+        # task_id -> when it first became cluster-infeasible (grace window
+        # lets the autoscaler add capacity before the task errors)
+        self._infeasible_since: Dict[str, float] = {}
+        # in-flight local dispatches (queued-or-running): heartbeated to the
+        # GCS so the autoscaler never scales away a node with assigned work
+        self._active_dispatches = 0
+        # task_id -> first time its dispatch target was unreachable
+        self._unreachable_since: Dict[str, float] = {}
         self._max_workers = max(1, int(ncpus))
         self._shutting_down = False
         # committed placement-group bundle reservations living on THIS node:
@@ -173,7 +185,10 @@ class NodeAgent:
         while True:
             await asyncio.sleep(period)
             try:
-                ok = await self.gcs.call("heartbeat", node_id=self.hex, available=self.available)
+                ok = await self.gcs.call(
+                    "heartbeat", node_id=self.hex, available=self.available,
+                    load={"dispatching": self._active_dispatches},
+                )
                 if not ok:
                     await self.gcs.call(
                         "register_node",
@@ -197,8 +212,9 @@ class NodeAgent:
         prev_state = w.state
         w.state = "DEAD"
         self._workers.pop(w.worker_id, None)
-        if w in self._idle_workers:
-            self._idle_workers.remove(w)
+        pool = self._idle_workers.get(w.env_hash)
+        if pool and w in pool:
+            pool.remove(w)
         logger.warning("worker %s died (state=%s)", w.worker_id[:8], prev_state)
         if w.tpu_chips is not None:
             self._return_chips(w.tpu_chips)
@@ -225,7 +241,10 @@ class NodeAgent:
                 pass
 
     # ----------------------------------------------------------- worker pool
-    async def _spawn_worker(self, tpu_chips: Optional[Tuple[int, ...]] = None) -> _WorkerHandle:
+    async def _spawn_worker(self, tpu_chips: Optional[Tuple[int, ...]] = None,
+                            renv: Optional[Dict[str, Any]] = None,
+                            env_hash: str = "",
+                            staged_cwd: Optional[str] = None) -> _WorkerHandle:
         import uuid
 
         worker_id = uuid.uuid4().hex
@@ -234,6 +253,12 @@ class NodeAgent:
         env["RAY_TPU_AGENT_ADDR"] = self.rpc.address
         env["RAY_TPU_GCS_ADDR"] = self.gcs_address
         env["RAY_TPU_NODE_ID"] = self.hex
+        if renv and renv.get("env_vars"):
+            env.update(renv["env_vars"])
+        if staged_cwd:
+            # staged working_dir: cwd + importable (reference working_dir
+            # plugin semantics)
+            env["PYTHONPATH"] = staged_cwd + os.pathsep + env.get("PYTHONPATH", "")
         if tpu_chips is not None:
             # dedicated TPU worker: sees exactly its chip subset
             # (accelerators.py visible_chip_env, reference tpu.py:155-195)
@@ -255,12 +280,24 @@ class NodeAgent:
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.node.worker_main"],
             env=env, stdout=logfile, stderr=subprocess.STDOUT,
-            cwd=os.getcwd(),
+            cwd=staged_cwd or os.getcwd(),
         )
         handle = _WorkerHandle(proc, worker_id)
         handle.tpu_chips = tpu_chips
+        handle.env_hash = env_hash
+        handle.staged_cwd = staged_cwd
         self._workers[worker_id] = handle
         return handle
+
+    def _runtime_env_of(self, spec: Dict[str, Any]):
+        """(renv, env_hash) for a task/actor spec. The driver already
+        normalized/validated and replaced working_dir with its content
+        hash."""
+        from ray_tpu.core.runtime_env import env_hash as _h
+
+        renv = {k: v for k, v in (spec.get("runtime_env") or {}).items()
+                if not k.startswith("__")}
+        return (renv or None), _h(renv)
 
     # ------------------------------------------------------- TPU chip leasing
     def _valid_chip_count(self, n: int) -> bool:
@@ -309,22 +346,27 @@ class NodeAgent:
         except Exception:  # noqa: BLE001
             pass
 
-    async def _lease_tpu_worker(self, n: int) -> _WorkerHandle:
+    async def _lease_tpu_worker(self, n: int, env_hash: str = "",
+                                renv: Optional[Dict[str, Any]] = None) -> _WorkerHandle:
         """Lease a dedicated worker for n chips: exact-size warm reuse first
-        (libtpu init is seconds on real chips), else spawn on freshly
-        assigned chip ids. Owns the whole chip lifecycle on failure."""
+        (libtpu init is seconds on real chips; runtime env must match too),
+        else spawn on freshly assigned chip ids. Owns the whole chip
+        lifecycle on failure."""
         for key, idles in self._tpu_idle.items():
             if len(key) != n:
                 continue
-            while idles:
-                w = idles.pop()
-                if w.proc.poll() is None and w.state == "IDLE":
+            for w in list(idles):
+                if (w.proc.poll() is None and w.state == "IDLE"
+                        and w.env_hash == env_hash):
+                    idles.remove(w)
                     w.state = "LEASED"
                     return w
         chips = self._take_chips(n)
         if chips is None:
             raise TimeoutError("TPU chips unavailable")
-        w = await self._spawn_worker(tpu_chips=chips)
+        staged = await self._stage_runtime_env(renv) if renv else None
+        w = await self._spawn_worker(tpu_chips=chips, renv=renv,
+                                     env_hash=env_hash, staged_cwd=staged)
         deadline = time.monotonic() + config.worker_start_timeout_s
         try:
             while not w.ready.is_set():
@@ -366,7 +408,7 @@ class NodeAgent:
         w.state = "IDLE"
         w.ready.set()
         if w.tpu_chips is None:
-            self._idle_workers.append(w)
+            self._idle_workers.setdefault(w.env_hash, []).append(w)
         else:
             # dedicated TPU worker: park in the chip-keyed pool so a worker
             # whose original lease timed out is reusable/reclaimable instead
@@ -377,31 +419,68 @@ class NodeAgent:
                 pool.append(w)
         return True
 
-    async def _lease_worker(self, timeout: Optional[float] = None) -> _WorkerHandle:
+    async def _lease_worker(self, timeout: Optional[float] = None,
+                            env_hash: str = "",
+                            renv: Optional[Dict[str, Any]] = None) -> _WorkerHandle:
         deadline = time.monotonic() + (timeout or config.worker_start_timeout_s)
+        staged = await self._stage_runtime_env(renv) if renv else None
         while True:
-            while self._idle_workers:
-                w = self._idle_workers.pop()
+            idles = self._idle_workers.get(env_hash, [])
+            while idles:
+                w = idles.pop()
                 if w.state == "IDLE" and w.proc.poll() is None:
                     w.state = "LEASED"
                     return w
             # Cap counts only task-pool workers: actors hold their workers for
             # life and are bounded by node RESOURCES, not the pool (matching
             # the reference, where dedicated actor workers don't consume the
-            # task worker pool).
+            # task worker pool). At the cap, idle workers of OTHER runtime
+            # envs are evicted — they can never serve this env, and without
+            # eviction the Nth distinct env would starve forever.
             pool = [w for w in self._workers.values() if w.state != "ACTOR"]
             starting = [w for w in pool if w.state == "STARTING"]
             if len(pool) < self._max_workers or not starting:
+                if len(pool) >= self._max_workers * 2:
+                    self._evict_idle_other_env(env_hash)
+                    pool = [w for w in self._workers.values() if w.state != "ACTOR"]
                 if len(pool) < self._max_workers * 2:
-                    await self._spawn_worker()
+                    await self._spawn_worker(renv=renv, env_hash=env_hash,
+                                             staged_cwd=staged)
             await asyncio.sleep(0.02)
             if time.monotonic() > deadline:
                 raise TimeoutError("timed out waiting for a worker")
 
+    def _evict_idle_other_env(self, env_hash: str) -> bool:
+        for h, idles in list(self._idle_workers.items()):
+            if h == env_hash:
+                continue
+            while idles:
+                w = idles.pop()
+                if w.state == "IDLE" and w.proc.poll() is None:
+                    self._kill_worker(w)
+                    if w.client_holder:
+                        asyncio.ensure_future(
+                            self.gcs.call("drop_holder", holder=w.client_holder)
+                        )
+                    return True
+            self._idle_workers.pop(h, None)
+        return False
+
+    async def _stage_runtime_env(self, renv: Dict[str, Any]) -> Optional[str]:
+        from ray_tpu.core.runtime_env import kv_key, stage_package
+
+        h = renv.get("working_dir_hash")
+        if not h:
+            return None
+        payload = await self.gcs.call("kv_get", key=kv_key(h))
+        if payload is None:
+            raise KeyError(f"working_dir package {h} not found in GCS KV")
+        return stage_package(payload, h, self.session_dir)
+
     def _release_worker(self, w: _WorkerHandle) -> None:
         if w.state == "LEASED" and w.proc.poll() is None:
             w.state = "IDLE"
-            self._idle_workers.append(w)
+            self._idle_workers.setdefault(w.env_hash, []).append(w)
 
     # ------------------------------------------------------------ object api
     async def rpc_create_object(self, object_id: str, size: int) -> Dict[str, Any]:
@@ -724,6 +803,8 @@ class NodeAgent:
             except Exception:  # noqa: BLE001
                 logger.exception("failed to store error objects")
         finally:
+            self._unreachable_since.pop(spec.get("task_id", ""), None)
+            self._infeasible_since.pop(spec.get("task_id", ""), None)
             # release the task pin: returns stay alive through the
             # submitter's holder; deps fall back to their own holders
             pinned = (spec.get("deps") or []) + (spec.get("returns") or [])
@@ -766,7 +847,8 @@ class NodeAgent:
         fut: asyncio.Future = loop.create_future()
         self._sched_queue.append((
             {"resources": spec.get("resources") or {},
-             "strategy": spec.get("strategy") or {}},
+             "strategy": spec.get("strategy") or {},
+             "req_id": spec.get("task_id", "")},
             fut,
         ))
         if self._sched_drainer is None or self._sched_drainer.done():
@@ -855,24 +937,41 @@ class NodeAgent:
             skip_local = False
             self._set_task_state(tid, f"placed:{(target or 'none')[:8]}")
             if target is None:
-                # infeasible now: backoff-retry without consuming an attempt
+                # unplaceable now: backoff-retry without consuming an attempt.
+                # Even CLUSTER-infeasible shapes wait out a grace window —
+                # the unmet-demand ledger this retry keeps feeding is exactly
+                # what the autoscaler scales up from (reference: infeasible
+                # tasks pend while the autoscaler reacts; they don't error)
                 feasible = await self._check_feasible(spec)
                 if not feasible:
-                    await self._store_error(
-                        spec,
-                        f"Task {spec.get('name')} is infeasible: requires "
-                        f"{spec.get('resources')} and no alive node can ever satisfy it",
-                    )
-                    return
+                    start = self._infeasible_since.setdefault(tid, time.monotonic())
+                    if time.monotonic() - start > config.infeasible_task_grace_s:
+                        self._infeasible_since.pop(tid, None)
+                        await self._store_error(
+                            spec,
+                            f"Task {spec.get('name')} is infeasible: requires "
+                            f"{spec.get('resources')}, no alive node can satisfy "
+                            f"it, and none appeared within "
+                            f"{config.infeasible_task_grace_s}s",
+                        )
+                        return
+                    self._set_task_state(tid, "pending:infeasible")
+                    await asyncio.sleep(0.5)
+                    continue
+                self._infeasible_since.pop(tid, None)
                 await asyncio.sleep(0.05)
                 continue
+            self._infeasible_since.pop(tid, None)
+            dispatch_started = False
             try:
                 if target == self.hex:
+                    dispatch_started = True
                     result = await self._dispatch_local(spec)
                 else:
                     peer = await self._peer(target)
                     if peer is None:
                         raise RpcConnectionError(f"no route to node {target[:8]}")
+                    dispatch_started = True
                     result = await peer.call("dispatch_task", spec=spec, timeout=None)
                 if result.get("ok"):
                     self._set_task_state(tid, "finished")
@@ -892,6 +991,18 @@ class NodeAgent:
                     continue
             except (RpcConnectionError, RpcError, TimeoutError) as e:
                 last_error = str(e)
+                if isinstance(e, RpcConnectionError) and not dispatch_started:
+                    # target unreachable BEFORE the task could start: a pure
+                    # PLACEMENT problem (node died or was scaled down; health
+                    # checks lag by seconds) — re-place without consuming task
+                    # retries, within a grace window. Connection loss MID-call
+                    # must consume an attempt (at-most-once for retries=0).
+                    start = self._unreachable_since.setdefault(tid, time.monotonic())
+                    if time.monotonic() - start < config.dispatch_unreachable_grace_s:
+                        self._set_task_state(tid, "replacing:unreachable-node")
+                        await asyncio.sleep(0.2)
+                        continue
+            self._unreachable_since.pop(tid, None)
             self._set_task_state(tid, f"retrying:{last_error[:40]}")
             attempt += 1
             await asyncio.sleep(min(0.05 * (2 ** attempt), 1.0))
@@ -914,6 +1025,13 @@ class NodeAgent:
         return await self._dispatch_local(spec)
 
     async def _dispatch_local(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        self._active_dispatches += 1
+        try:
+            return await self._dispatch_local_inner(spec)
+        finally:
+            self._active_dispatches -= 1
+
+    async def _dispatch_local_inner(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         # 1. dependencies local
         deps: List[str] = spec.get("deps") or []
         try:
@@ -946,14 +1064,19 @@ class NodeAgent:
                 f"{self._total_chips}-chip host (valid: 1, 2, 4, or all chips)",
             )
             return {"ok": False, "retryable": False, "error": "invalid TPU count"}
+        renv, env_hash = self._runtime_env_of(spec)
         try:
             if tpu_need > 0:
-                w = await self._lease_tpu_worker(tpu_need)
+                w = await self._lease_tpu_worker(tpu_need, env_hash=env_hash, renv=renv)
             else:
-                w = await self._lease_worker()
+                w = await self._lease_worker(env_hash=env_hash, renv=renv)
         except TimeoutError as e:
             self._release_token(token)
             return {"ok": False, "retryable": True, "reason": "busy", "error": str(e)}
+        except Exception as e:  # noqa: BLE001 - staging/env errors are fatal
+            self._release_token(token)
+            await self._store_error(spec, f"runtime_env setup failed: {e}")
+            return {"ok": False, "retryable": False, "error": str(e)}
         w.lease_token = token
         try:
             result = await w.client.call("run_task", spec=spec, timeout=None)
@@ -1103,14 +1226,19 @@ class NodeAgent:
                 f"{self._total_chips}-chip host (valid: 1, 2, 4, or all chips)",
             )
             return {"ok": False, "retryable": False, "error": "invalid TPU count"}
+        renv, env_hash = self._runtime_env_of(spec)
         try:
             if tpu_need > 0:
-                w = await self._lease_tpu_worker(tpu_need)
+                w = await self._lease_tpu_worker(tpu_need, env_hash=env_hash, renv=renv)
             else:
-                w = await self._lease_worker()
+                w = await self._lease_worker(env_hash=env_hash, renv=renv)
         except TimeoutError as e:
             self._release_token(token)
             return {"ok": False, "retryable": True, "error": str(e)}
+        except Exception as e:  # noqa: BLE001 - staging/env errors are fatal
+            self._release_token(token)
+            await self._store_error(spec, f"runtime_env setup failed: {e}")
+            return {"ok": False, "retryable": False, "error": str(e)}
         w.state = "ACTOR"
         w.actor_id = spec["actor_id"]
         w._actor_token = token
@@ -1133,7 +1261,7 @@ class NodeAgent:
                 self._release_tpu_worker(w)
             else:
                 w.state = "IDLE"
-                self._idle_workers.append(w)
+                self._idle_workers.setdefault(w.env_hash, []).append(w)
             return {"ok": False, "retryable": False, "error": result.get("error", "")}
         await self.gcs.call(
             "actor_started", actor_id=spec["actor_id"], node_id=self.hex, address=w.address
@@ -1301,7 +1429,7 @@ class NodeAgent:
             "available": self.available,
             "labels": self.labels,
             "workers": len(self._workers),
-            "idle_workers": len(self._idle_workers),
+            "idle_workers": sum(len(v) for v in self._idle_workers.values()),
             "store": self.store.usage(),
         }
 

@@ -1,0 +1,133 @@
+"""Runtime environments: per-task/actor env_vars + working_dir.
+
+Reference capability: python/ray/_private/runtime_env/ (runtime_env_agent +
+working_dir/pip/conda plugins). Redesign for a zero-egress TPU fleet:
+
+- ``env_vars``: merged into a DEDICATED worker's process environment; the
+  worker pool is keyed by the runtime-env hash, so workers are reused within
+  an env and never shared across envs (reference: worker pool env isolation);
+- ``working_dir``: a local directory, packaged (zip) by the submitting
+  driver into GCS KV once per content hash; every agent stages it into its
+  session dir and runs the worker with cwd + sys.path there — code ships to
+  nodes without a shared filesystem;
+- ``pip``/``conda``: rejected with a clear error — this framework targets
+  hermetic TPU images with zero egress (installing at task time is exactly
+  what the fleet design forbids). The key is VALIDATED, not ignored.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+from typing import Any, Dict, Optional, Tuple
+
+MAX_PACKAGE_BYTES = 64 * 1024 * 1024
+_INTERNAL_KEYS = ("__actor_name__", "__actor_namespace__")
+SUPPORTED_KEYS = {"env_vars", "working_dir"}
+REJECTED_KEYS = {"pip", "conda", "container", "py_executable"}
+
+
+def normalize(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Strip internal keys, validate, canonicalize. Raises on unsupported
+    install-at-runtime requests."""
+    env = {k: v for k, v in (runtime_env or {}).items() if k not in _INTERNAL_KEYS}
+    if not env:
+        return {}
+    bad = set(env) & REJECTED_KEYS
+    if bad:
+        raise ValueError(
+            f"runtime_env keys {sorted(bad)} are not supported: this "
+            "framework targets hermetic zero-egress TPU images (bake "
+            "dependencies into the image; use working_dir/env_vars for code "
+            "and configuration)"
+        )
+    unknown = set(env) - SUPPORTED_KEYS
+    if unknown:
+        raise ValueError(f"unknown runtime_env keys {sorted(unknown)}; "
+                         f"supported: {sorted(SUPPORTED_KEYS)}")
+    if "env_vars" in env:
+        ev = env["env_vars"]
+        if not isinstance(ev, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in ev.items()
+        ):
+            raise ValueError("runtime_env env_vars must be Dict[str, str]")
+    return env
+
+
+def env_hash(env: Dict[str, Any]) -> str:
+    if not env:
+        return ""
+    return hashlib.sha1(
+        json.dumps(env, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+# ------------------------------------------------------------- working_dir
+def package_working_dir(path: str) -> Tuple[str, bytes]:
+    """Zip a local directory -> (content_hash, payload). Deterministic
+    ordering so identical trees share one KV entry."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir {path!r} is not a directory")
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in sorted(os.walk(path)):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git", ".venv"))
+            for name in sorted(files):
+                if name.endswith((".pyc", ".pyo")):
+                    continue
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                total += os.path.getsize(full)
+                if total > MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"working_dir {path!r} exceeds "
+                        f"{MAX_PACKAGE_BYTES >> 20}MB packaged"
+                    )
+                zi = zipfile.ZipInfo(rel)  # fixed metadata: deterministic hash
+                with open(full, "rb") as f:
+                    zf.writestr(zi, f.read())
+    payload = buf.getvalue()
+    return hashlib.sha1(payload).hexdigest()[:16], payload
+
+
+def kv_key(content_hash: str) -> str:
+    return f"runtimeenv:{content_hash}"
+
+
+def stage_package(payload: bytes, content_hash: str, session_dir: str) -> str:
+    """Extract a working_dir package into the node session dir. Idempotent
+    AND concurrency-safe: extraction happens in a private temp dir that is
+    atomically renamed into place, so agents sharing a session dir never
+    expose partially-written modules to workers."""
+    import uuid
+
+    base = os.path.join(session_dir, "runtime_envs")
+    dest = os.path.join(base, content_hash)
+    if os.path.isdir(dest):
+        return dest
+    os.makedirs(base, exist_ok=True)
+    tmp = os.path.join(base, f".tmp-{content_hash}-{uuid.uuid4().hex[:8]}")
+    os.makedirs(tmp)
+    try:
+        with zipfile.ZipFile(io.BytesIO(payload)) as zf:
+            for info in zf.infolist():  # refuse absolute/.. escapes
+                name = info.filename
+                if name.startswith("/") or ".." in name.split("/"):
+                    raise ValueError(f"unsafe path in working_dir package: {name!r}")
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            pass  # another agent won the race; its copy is complete
+    finally:
+        if os.path.isdir(tmp):
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return dest

@@ -1,0 +1,14 @@
+from ray_tpu.rl.grpo import (
+    GRPOConfig,
+    compute_group_advantages,
+    make_grpo_step,
+    make_logprob_fn,
+)
+from ray_tpu.rl.ppo import PPOConfig, gae_advantages, make_ppo_step
+from ray_tpu.rl.trainer import GRPOTrainer
+
+__all__ = [
+    "GRPOConfig", "GRPOTrainer", "PPOConfig",
+    "compute_group_advantages", "gae_advantages",
+    "make_grpo_step", "make_logprob_fn", "make_ppo_step",
+]

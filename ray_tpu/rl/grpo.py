@@ -1,0 +1,136 @@
+"""GRPO: Group Relative Policy Optimization for LLM RLHF.
+
+Capability named in BASELINE.json ("PPO/GRPO RLHF"); the reference covers
+this space with rllib/ (torch policy classes + NCCL). TPU-first redesign:
+
+- the ENTIRE update — per-token logprobs, clipped surrogate, KL penalty
+  against the frozen reference policy, optimizer — is ONE pjit-compiled XLA
+  program over the mesh (no eager policy objects);
+- no value network: advantages are group-relative (sample G completions per
+  prompt, normalize rewards within the group), which removes the critic's
+  memory footprint — the feature that makes GRPO the TPU-friendly choice;
+- rollouts come from the serve plane's continuous-batching engine
+  (serve/llm.py), so training and inference share one decode path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig, llama_hidden
+
+
+@dataclasses.dataclass(frozen=True)
+class GRPOConfig:
+    group_size: int = 4
+    clip_eps: float = 0.2
+    kl_coef: float = 0.02
+    temperature: float = 1.0
+    max_new_tokens: int = 64
+    epochs_per_batch: int = 1
+
+
+def compute_group_advantages(rewards: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """rewards: [num_prompts, group_size] -> advantages, same shape,
+    normalized WITHIN each prompt's group (the GRPO baseline)."""
+    mean = rewards.mean(axis=-1, keepdims=True)
+    std = rewards.std(axis=-1, keepdims=True)
+    return (rewards - mean) / (std + eps)
+
+
+def make_logprob_fn(config: LlamaConfig, mesh=None):
+    """Returns logprobs(params, tokens) -> per-token logprob [B, T-1] of
+    token t+1 given prefix..t. Vocab reduction uses a one-hot select (tp-
+    sharded vocab partitions cleanly; a gather would force replication)."""
+
+    def logprobs(params, tokens):
+        x = llama_hidden(params, tokens, config, mesh=mesh)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed_tokens"].T.astype(config.dtype)
+        logits = jax.lax.dot_general(
+            x, head, (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [B, T, V] fp32
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        nxt = tokens[:, 1:]
+        onehot = jax.nn.one_hot(nxt, logits.shape[-1], dtype=logits.dtype)
+        gold = jnp.sum(logits[:, :-1] * onehot, axis=-1)
+        return gold - logz[:, :-1]
+
+    return jax.jit(logprobs)
+
+
+def grpo_loss(
+    params,
+    tokens,          # [N, T] int32 (prompt + completion, right-padded)
+    completion_mask,  # [N, T-1] 1.0 where position t PREDICTS a completion token
+    advantages,      # [N] group-relative advantage per sequence
+    old_logprobs,    # [N, T-1] logprobs under the rollout policy
+    ref_logprobs,    # [N, T-1] logprobs under the frozen reference policy
+    config: LlamaConfig,
+    clip_eps: float,
+    kl_coef: float,
+    mesh=None,
+):
+    x = llama_hidden(params, tokens, config, mesh=mesh)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T.astype(config.dtype)
+    logits = jax.lax.dot_general(
+        x, head, (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    onehot = jax.nn.one_hot(tokens[:, 1:], logits.shape[-1], dtype=logits.dtype)
+    logp = jnp.sum(logits[:, :-1] * onehot, axis=-1) - logz[:, :-1]  # [N, T-1]
+
+    ratio = jnp.exp(logp - old_logprobs)
+    adv = advantages[:, None]
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
+    denom = jnp.maximum(completion_mask.sum(), 1.0)
+    pg_loss = -jnp.sum(jnp.minimum(unclipped, clipped) * completion_mask) / denom
+
+    # k3 KL estimator (unbiased, positive): exp(r) - r - 1, r = ref - policy
+    r = ref_logprobs - logp
+    kl = jnp.sum((jnp.exp(r) - r - 1.0) * completion_mask) / denom
+
+    loss = pg_loss + kl_coef * kl
+    return loss, {"pg_loss": pg_loss, "kl": kl,
+                  "ratio_mean": jnp.sum(ratio * completion_mask) / denom}
+
+
+def make_grpo_step(
+    config: LlamaConfig,
+    optimizer,
+    grpo: GRPOConfig,
+    mesh=None,
+    donate: bool = True,
+):
+    """(state, batch) -> (state, metrics); batch = dict(tokens,
+    completion_mask, advantages, old_logprobs, ref_logprobs). One compiled
+    XLA program (gradients + optimizer + collectives), like train/step.py."""
+    import optax
+
+    from ray_tpu.train.step import TrainState
+
+    def step_fn(state: TrainState, batch):
+        def loss_fn(params):
+            return grpo_loss(
+                params, batch["tokens"], batch["completion_mask"],
+                batch["advantages"], batch["old_logprobs"],
+                batch["ref_logprobs"], config, grpo.clip_eps, grpo.kl_coef,
+                mesh=mesh,
+            )
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
+        return new_state, {"loss": loss, **aux, "step": new_state.step}
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step_fn, donate_argnums=donate_argnums)

@@ -1,0 +1,134 @@
+"""PPO for LLM policies: clipped surrogate + value head + GAE.
+
+Reference capability: rllib PPO (rllib/algorithms/ppo) — torch policies,
+sample batches, NCCL allreduce. TPU-first: the value function is a linear
+head on the SAME trunk (no second model), GAE runs as a lax.scan, and the
+whole update is one jitted program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig, llama_hidden
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    clip_eps: float = 0.2
+    value_clip: float = 0.2
+    value_coef: float = 0.5
+    entropy_coef: float = 0.0
+    gamma: float = 1.0
+    lam: float = 0.95
+    epochs_per_batch: int = 2
+
+
+def init_value_head(config: LlamaConfig, key) -> Dict[str, Any]:
+    h = config.hidden_size
+    return {"w": (jax.random.normal(key, (h,), jnp.float32) * h**-0.5),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def value_estimates(params, value_head, tokens, config: LlamaConfig, mesh=None):
+    """Per-position value V(s_t): linear head on the trunk hidden states."""
+    x = llama_hidden(params, tokens, config, mesh=mesh)
+    return x.astype(jnp.float32) @ value_head["w"] + value_head["b"]  # [B, T]
+
+
+def gae_advantages(rewards, values, mask, gamma: float, lam: float):
+    """Generalized Advantage Estimation over token positions.
+
+    rewards/values/mask: [B, T] fp32 (mask zeros out padding). Returns
+    (advantages [B, T], returns [B, T]). Runs as a reverse lax.scan — no
+    per-token Python loop."""
+    b, t = rewards.shape
+    next_values = jnp.concatenate([values[:, 1:], jnp.zeros((b, 1))], axis=1)
+    deltas = (rewards + gamma * next_values * mask - values) * mask
+
+    def body(carry, xs):
+        delta_t, mask_t = xs
+        carry = delta_t + gamma * lam * mask_t * carry
+        return carry, carry
+
+    _, adv_rev = jax.lax.scan(
+        body, jnp.zeros(b), (deltas.T[::-1], mask.T[::-1])
+    )
+    advantages = adv_rev[::-1].T * mask
+    return advantages, advantages + values * mask
+
+
+def ppo_loss(params, value_head, batch, config: LlamaConfig, ppo: PPOConfig, mesh=None):
+    tokens = batch["tokens"]              # [B, T]
+    mask = batch["mask"]                  # [B, T-1] action positions
+    old_logp = batch["old_logprobs"]      # [B, T-1]
+    advantages = batch["advantages"]      # [B, T-1]
+    returns = batch["returns"]            # [B, T-1]
+    old_values = batch["old_values"]      # [B, T-1]
+
+    x = llama_hidden(params, tokens, config, mesh=mesh)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T.astype(config.dtype)
+    logits = jax.lax.dot_general(
+        x, head, (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    onehot = jax.nn.one_hot(tokens[:, 1:], logits.shape[-1], dtype=logits.dtype)
+    logp = jnp.sum(logits[:, :-1] * onehot, axis=-1) - logz[:, :-1]
+    values = (x[:, :-1].astype(jnp.float32) @ value_head["w"] + value_head["b"])
+
+    denom = jnp.maximum(mask.sum(), 1.0)
+    # normalized advantages (standard PPO practice)
+    amean = jnp.sum(advantages * mask) / denom
+    astd = jnp.sqrt(jnp.sum(((advantages - amean) * mask) ** 2) / denom) + 1e-6
+    adv = (advantages - amean) / astd
+
+    ratio = jnp.exp(logp - old_logp)
+    pg = -jnp.sum(jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - ppo.clip_eps, 1 + ppo.clip_eps) * adv
+    ) * mask) / denom
+
+    v_clipped = old_values + jnp.clip(values - old_values,
+                                      -ppo.value_clip, ppo.value_clip)
+    v_loss = 0.5 * jnp.sum(
+        jnp.maximum((values - returns) ** 2, (v_clipped - returns) ** 2) * mask
+    ) / denom
+
+    probs = jax.nn.softmax(logits[:, :-1], axis=-1)
+    entropy = -jnp.sum(jnp.sum(probs * jnp.where(probs > 0, jnp.log(probs), 0.0), -1)
+                       * mask) / denom
+
+    loss = pg + ppo.value_coef * v_loss - ppo.entropy_coef * entropy
+    return loss, {"pg_loss": pg, "value_loss": v_loss, "entropy": entropy}
+
+
+def make_ppo_step(config: LlamaConfig, optimizer, ppo: PPOConfig, mesh=None,
+                  donate: bool = True):
+    """(state, value_head, vh_opt_state, batch) -> updated triple + metrics.
+    Policy and value head update jointly in one compiled program."""
+    import optax
+
+    from ray_tpu.train.step import TrainState
+
+    def step_fn(state: TrainState, value_head, vh_opt, batch):
+        def loss_fn(params, vh):
+            return ppo_loss(params, vh, batch, config, ppo, mesh=mesh)
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                                has_aux=True)(state.params, value_head)
+        pgrads, vgrads = grads
+        updates, new_opt = optimizer.update(pgrads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        vh_updates, new_vh_opt = optimizer.update(vgrads, vh_opt, value_head)
+        new_vh = optax.apply_updates(value_head, vh_updates)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt)
+        return new_state, new_vh, new_vh_opt, {"loss": loss, **aux}
+
+    donate_argnums = (0, 1, 2) if donate else ()
+    return jax.jit(step_fn, donate_argnums=donate_argnums)

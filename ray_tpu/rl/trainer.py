@@ -1,0 +1,137 @@
+"""GRPOTrainer: rollout → reward → group advantage → jitted update loop.
+
+Reference capability: rllib Algorithm.train() (rollout workers + learner);
+here rollouts run on the serve plane's continuous-batching LLMEngine (the
+same decode path production serving uses) and the learner is the one-program
+GRPO step. Single-host by default; the learner step accepts a mesh for
+sharded multi-chip updates (same TrainState plumbing as train/).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.rl.grpo import (
+    GRPOConfig,
+    compute_group_advantages,
+    make_grpo_step,
+    make_logprob_fn,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("rl.trainer")
+
+
+class GRPOTrainer:
+    """reward_fn(prompt_tokens, completion_tokens) -> float."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        reward_fn: Callable[[List[int], List[int]], float],
+        grpo: Optional[GRPOConfig] = None,
+        optimizer=None,
+        params=None,
+        num_slots: int = 8,
+        mesh=None,
+    ):
+        import jax
+
+        from ray_tpu.serve.llm import LLMEngine
+        from ray_tpu.train.step import TrainState, default_optimizer
+
+        self.config = config
+        self.grpo = grpo or GRPOConfig()
+        self.reward_fn = reward_fn
+        self.mesh = mesh
+        optimizer = optimizer or default_optimizer(lr=1e-5, warmup_steps=1,
+                                                   total_steps=10_000)
+        self._optimizer = optimizer
+        from ray_tpu.models.llama import llama_init
+
+        params = params if params is not None else llama_init(config, jax.random.key(0))
+        self.state = TrainState(
+            step=jax.numpy.zeros((), jax.numpy.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+        )
+        # frozen reference policy for the KL penalty
+        self._ref_params = jax.tree.map(lambda x: x, params)
+        self._logprob = make_logprob_fn(config, mesh=mesh)
+        self._step = make_grpo_step(config, optimizer, self.grpo, mesh=mesh,
+                                    donate=False)
+        self.engine = LLMEngine(
+            config, params=params, num_slots=num_slots,
+            temperature=self.grpo.temperature,
+        )
+
+    # ------------------------------------------------------------- rollouts
+    def _rollout(self, prompts: Sequence[List[int]]):
+        """G completions per prompt via the continuous-batching engine."""
+        G = self.grpo.group_size
+        outs: List[List[int]] = []
+        metas: List[Dict[str, Any]] = []
+        for p in prompts:
+            for _ in range(G):
+                r = self.engine.generate(list(p), max_tokens=self.grpo.max_new_tokens)
+                outs.append(r["tokens"])
+                metas.append({"prompt_len": len(p)})
+        return outs, metas
+
+    def train_step(self, prompts: Sequence[List[int]]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        G = self.grpo.group_size
+        completions, metas = self._rollout(prompts)
+        rewards = np.asarray([
+            self.reward_fn(list(p), c)
+            for p, group in zip(prompts, _chunks(completions, G))
+            for c in group
+        ], np.float32).reshape(len(prompts), G)
+        advantages = np.asarray(
+            compute_group_advantages(jnp.asarray(rewards)))
+
+        # pack sequences: [prompt + completion], right-padded
+        seqs = [list(p) + c for p, group in zip(prompts, _chunks(completions, G))
+                for c in group]
+        T = max(len(s) for s in seqs)
+        N = len(seqs)
+        tokens = np.zeros((N, T), np.int32)
+        comp_mask = np.zeros((N, T - 1), np.float32)
+        for i, (s, meta) in enumerate(zip(seqs, metas)):
+            tokens[i, :len(s)] = s
+            # position t predicts token t+1: completion predictions start at
+            # prompt_len-1 and stop before padding
+            comp_mask[i, meta["prompt_len"] - 1:len(s) - 1] = 1.0
+
+        tokens = jnp.asarray(tokens)
+        comp_mask = jnp.asarray(comp_mask)
+        old_logprobs = self._logprob(self.state.params, tokens)
+        ref_logprobs = self._logprob(self._ref_params, tokens)
+        batch = {
+            "tokens": tokens,
+            "completion_mask": comp_mask,
+            "advantages": jnp.asarray(advantages.reshape(-1)),
+            "old_logprobs": old_logprobs,
+            "ref_logprobs": ref_logprobs,
+        }
+        metrics: Dict[str, Any] = {}
+        for _ in range(self.grpo.epochs_per_batch):
+            self.state, metrics = self._step(self.state, batch)
+        # the engine serves the UPDATED policy for the next rollouts
+        self.engine.params = self.state.params
+        out = {k: float(v) for k, v in metrics.items()}
+        out["reward_mean"] = float(rewards.mean())
+        out["reward_std"] = float(rewards.std())
+        return out
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+
+def _chunks(xs: List[Any], n: int):
+    for i in range(0, len(xs), n):
+        yield xs[i:i + n]

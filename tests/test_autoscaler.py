@@ -1,0 +1,67 @@
+"""Autoscaler e2e on subprocess nodes (reference analogue:
+fake_multi_node autoscaler tests)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import AutoscalerConfig, LocalNodeProvider, StandardAutoscaler
+from ray_tpu.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def scaled_cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=c.gcs_address)
+    provider = LocalNodeProvider(c.gcs_address, session_dir=c.session_dir)
+    scaler = StandardAutoscaler(
+        c.gcs_address, provider,
+        AutoscalerConfig(min_workers=0, max_workers=2,
+                         worker_node_config={"num_cpus": 2},
+                         idle_timeout_s=6.0, update_interval_s=0.5),
+    )
+    scaler.start()
+    yield c, provider, scaler
+    scaler.stop()
+    for h in provider.non_terminated_nodes():
+        provider.terminate_node(h)
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_scales_up_on_unmet_demand_and_down_when_idle(scaled_cluster):
+    c, provider, scaler = scaled_cluster
+
+    @ray_tpu.remote(num_cpus=2)
+    def big(i):
+        time.sleep(0.2)
+        return i
+
+    # head has 1 CPU: these can never run without a scale-up
+    refs = [big.remote(i) for i in range(3)]
+    out = ray_tpu.get(refs, timeout=180)
+    assert sorted(out) == [0, 1, 2]
+    assert scaler.launched >= 1
+    assert len(provider.non_terminated_nodes()) >= 1
+
+    # idle: workers must come back down after the timeout
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if scaler.terminated >= 1:
+            break
+        time.sleep(0.5)
+    assert scaler.terminated >= 1, "idle worker was never terminated"
+
+
+def test_never_exceeds_max_workers(scaled_cluster):
+    c, provider, scaler = scaled_cluster
+
+    @ray_tpu.remote(num_cpus=2)
+    def burn(i):
+        time.sleep(0.3)
+        return i
+
+    refs = [burn.remote(i) for i in range(10)]
+    assert sorted(ray_tpu.get(refs, timeout=240)) == list(range(10))
+    assert len(provider.non_terminated_nodes()) <= 2
