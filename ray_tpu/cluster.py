@@ -45,10 +45,13 @@ class NodeHandle:
 class Cluster:
     """Spins up a GCS + N node agents as real subprocesses."""
 
-    def __init__(self, initialize_head: bool = True, head_node_args: Optional[Dict] = None):
+    def __init__(self, initialize_head: bool = True, head_node_args: Optional[Dict] = None,
+                 gcs_persist: bool = False):
         self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_cluster_")
         self._gcs_proc: Optional[subprocess.Popen] = None
         self.gcs_address: Optional[str] = None
+        self._gcs_persist_dir = (os.path.join(self.session_dir, "gcs_state")
+                                 if gcs_persist else None)
         self.nodes: List[NodeHandle] = []
         self._start_gcs()
         if initialize_head:
@@ -82,16 +85,36 @@ class Cluster:
             time.sleep(0.02)
         raise TimeoutError(f"{what} did not become ready in {timeout}s")
 
-    def _start_gcs(self) -> None:
+    def _start_gcs(self, port: int = 0) -> None:
         ready = os.path.join(self.session_dir, f"gcs-{uuid.uuid4().hex[:6]}.ready")
         log = open(os.path.join(self.session_dir, "gcs.log"), "ab")
+        cmd = [sys.executable, "-m", "ray_tpu.core.gcs.server",
+               "--ready-file", ready, "--port", str(port)]
+        if self._gcs_persist_dir:
+            cmd += ["--persist-dir", self._gcs_persist_dir]
         self._gcs_proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.gcs.server", "--ready-file", ready],
-            env=self._env(), stdout=log, stderr=subprocess.STDOUT,
+            cmd, env=self._env(), stdout=log, stderr=subprocess.STDOUT,
             start_new_session=True,
         )
         self.gcs_address = self._wait_ready_file(ready, self._gcs_proc, "GCS")
         logger.info("GCS at %s (session %s)", self.gcs_address, self.session_dir)
+
+    def kill_gcs(self) -> None:
+        """SIGKILL the GCS process (fault-tolerance testing)."""
+        if self._gcs_proc is not None:
+            try:
+                os.killpg(os.getpgid(self._gcs_proc.pid), signal.SIGKILL)
+            except Exception:
+                self._gcs_proc.kill()
+            self._gcs_proc.wait()
+
+    def restart_gcs(self) -> None:
+        """Restart the GCS on the SAME address (requires gcs_persist=True to
+        resume state). Agents reconnect via their heartbeat loops."""
+        port = int(self.gcs_address.rsplit(":", 1)[1])
+        self.kill_gcs()
+        time.sleep(0.2)
+        self._start_gcs(port=port)
 
     def add_node(
         self,

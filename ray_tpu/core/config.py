@@ -121,6 +121,8 @@ _DEFINITIONS = [
     ("max_lineage_bytes", 8 * 1024 * 1024, int,
      "Task specs above this size are not retained for lineage reconstruction."),
     # --- scheduling ---
+    ("gcs_snapshot_interval_s", 1.0, float,
+     "Interval between GCS state snapshots when --persist-dir is set."),
     ("dispatch_unreachable_grace_s", 15.0, float,
      "Re-place (without consuming task retries) when the dispatch target is "
      "unreachable, for this long — covers the health-check lag after a node "

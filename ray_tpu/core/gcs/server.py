@@ -26,6 +26,7 @@ tier of the reference maps to a snapshot/journal TODO, recorded in docs).
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -38,7 +39,9 @@ logger = get_logger("gcs")
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_dir: Optional[str] = None):
+        self.persist_dir = persist_dir
         self.rpc = RpcServer(host, port)
         self.rpc.register_object(self)
         # node_id(hex) -> info dict
@@ -82,6 +85,7 @@ class GcsServer:
         # object_holder_lease_s = crashed process, drop its holders.
         self.holder_last_seen: Dict[str, float] = {}
         self._gc_task: Optional[asyncio.Task] = None
+        self._persist_task: Optional[asyncio.Task] = None
         self._schedule_calls = 0  # batched RPCs received
         self._schedule_reqs = 0   # placement requests inside them
         # req_id -> (last_seen, shape): resource requests that could not be
@@ -97,12 +101,19 @@ class GcsServer:
 
             self._external = ExternalPolicyClient(config.external_scheduler_address)
             await self._external.start()
+        if self.persist_dir:
+            self._restore_snapshot()
+            self._persist_task = asyncio.ensure_future(self._persist_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())
         self._gc_task = asyncio.ensure_future(self._gc_loop())
         logger.info("GCS listening on %s:%d", host, port)
         return host, port
 
     async def stop(self) -> None:
+        if self._persist_task:
+            self._persist_task.cancel()
+            if self.persist_dir:
+                self._write_snapshot()
         if self._health_task:
             self._health_task.cancel()
         if self._gc_task:
@@ -912,6 +923,97 @@ class GcsServer:
     async def rpc_get_lineage(self, object_id: str) -> Optional[Dict[str, Any]]:
         return self.lineage.get(object_id)
 
+    # ------------------------------------------------------------ persistence
+    # Reference capability: src/ray/gcs/store_client/redis_store_client —
+    # control-plane state survives GCS process death. Redesign: periodic
+    # atomic msgpack snapshots to local disk (no external store to operate);
+    # agents re-register on heartbeat rejection and drivers reconnect, so a
+    # restarted GCS resumes from the last snapshot.
+    def _snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "nodes": self.nodes,
+            "available": self.available,
+            "node_load": self.node_load,
+            "kv": self.kv,
+            "actors": self.actors,
+            "named_actors": {f"{ns}\x00{name}": aid for (ns, name), aid
+                             in self.named_actors.items()},
+            "objects": {
+                o: {"size": r["size"], "locations": sorted(r["locations"]),
+                    "owner": r.get("owner", ""),
+                    "had_locations": r.get("had_locations", False)}
+                for o, r in self.objects.items()
+            },
+            "object_holders": {o: sorted(h) for o, h in self.object_holders.items()},
+            "object_contains": self.object_contains,
+            "lineage": self.lineage,
+            "pgs": self.pgs,
+            "job_counter": self._job_counter,
+        }
+
+    def _write_snapshot(self) -> None:
+        import msgpack
+
+        os.makedirs(self.persist_dir, exist_ok=True)
+        path = os.path.join(self.persist_dir, "gcs_snapshot.msgpack")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(self._snapshot_state(), use_bin_type=True))
+        os.replace(tmp, path)  # atomic: readers never see a torn snapshot
+
+    def _restore_snapshot(self) -> None:
+        import msgpack
+
+        path = os.path.join(self.persist_dir, "gcs_snapshot.msgpack")
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                s = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+        except Exception:  # noqa: BLE001 - a corrupt snapshot must not brick startup
+            logger.exception("snapshot restore failed; starting fresh")
+            return
+        self.nodes = s.get("nodes", {})
+        self.available = s.get("available", {})
+        self.node_load = s.get("node_load", {})
+        self.kv = s.get("kv", {})
+        self.actors = s.get("actors", {})
+        self.named_actors = {
+            tuple(k.split("\x00", 1)): v
+            for k, v in s.get("named_actors", {}).items()
+        }
+        self.objects = {
+            o: {"size": r["size"], "locations": set(r["locations"]),
+                "owner": r.get("owner", ""),
+                "had_locations": r.get("had_locations", False)}
+            for o, r in s.get("objects", {}).items()
+        }
+        self.object_holders = {o: set(h) for o, h in
+                               s.get("object_holders", {}).items()}
+        self.object_contains = s.get("object_contains", {})
+        self.lineage = s.get("lineage", {})
+        self.pgs = s.get("pgs", {})
+        self._job_counter = s.get("job_counter", 1)
+        # nodes must prove liveness again: stamp now so the health loop gives
+        # them a full window to heartbeat before declaring them dead
+        now = time.monotonic()
+        for node_id in self.nodes:
+            self.last_heartbeat[node_id] = now
+        logger.info(
+            "restored GCS snapshot: %d nodes, %d actors, %d objects, %d kv",
+            len(self.nodes), len(self.actors), len(self.objects), len(self.kv),
+        )
+
+    async def _persist_loop(self) -> None:
+        while True:
+            await asyncio.sleep(config.gcs_snapshot_interval_s)
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._write_snapshot
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("snapshot write failed")
+
     # ------------------------------------------------------------------ debug
     async def rpc_debug_state(self) -> Dict[str, Any]:
         return {
@@ -938,8 +1040,9 @@ def dict_config_snapshot() -> Dict[str, Any]:
 
 
 async def serve_forever(host: str = "127.0.0.1", port: int = 0,
-                        ready_file: Optional[str] = None) -> None:
-    server = GcsServer(host, port)
+                        ready_file: Optional[str] = None,
+                        persist_dir: Optional[str] = None) -> None:
+    server = GcsServer(host, port, persist_dir=persist_dir)
     h, p = await server.start()
     if ready_file:
         with open(ready_file, "w") as f:
@@ -954,8 +1057,10 @@ def main() -> None:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--ready-file", default=None)
+    parser.add_argument("--persist-dir", default=None)
     args = parser.parse_args()
-    asyncio.run(serve_forever(args.host, args.port, args.ready_file))
+    asyncio.run(serve_forever(args.host, args.port, args.ready_file,
+                              args.persist_dir))
 
 
 if __name__ == "__main__":

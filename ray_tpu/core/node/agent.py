@@ -200,6 +200,24 @@ class NodeAgent:
                     )
             except (RpcConnectionError, TimeoutError):
                 logger.warning("heartbeat to GCS failed")
+                await self._reconnect_gcs()
+
+    async def _reconnect_gcs(self) -> None:
+        """GCS restarted (or the connection broke): rebuild the client and
+        re-subscribe — with persistence the new GCS resumes from its snapshot
+        and this agent re-appears via the next heartbeat/register
+        (reference: raylet GCS reconnect, node_manager.cc:1181)."""
+        if self.gcs is not None and not self.gcs._closed:  # noqa: SLF001
+            return
+        try:
+            fresh = await RpcClient(self.gcs_address).connect(timeout=2.0)
+            await fresh.subscribe("nodes", self._on_node_event)
+            old, self.gcs = self.gcs, fresh
+            if old is not None:
+                await old.close()
+            logger.info("reconnected to GCS at %s", self.gcs_address)
+        except (RpcConnectionError, OSError):
+            pass  # still down; next heartbeat retries
 
     async def _supervise_loop(self) -> None:
         while True:

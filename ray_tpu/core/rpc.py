@@ -235,6 +235,7 @@ class RpcClient:
         self._sub_callbacks: Dict[str, Callable[[Any], None]] = {}
         self._send_lock: Optional[asyncio.Lock] = None
         self._closed = False
+        self._user_closed = False
 
     async def connect(self, timeout: Optional[float] = None) -> "RpcClient":
         timeout = timeout or config.rpc_connect_timeout_s
@@ -305,7 +306,37 @@ class RpcClient:
                 except TimeoutError:
                     attempt_timeout *= 2
                     continue
+                except RpcConnectionError:
+                    # server restarted (e.g. persistent GCS failover):
+                    # retry-safe methods survive by reconnecting in place
+                    if self._user_closed:
+                        raise
+                    await asyncio.sleep(min(0.2, remaining))
+                    try:
+                        await self._reconnect()
+                    except RpcConnectionError:
+                        continue
+                    continue
         return await self._call_once(method, timeout, params)
+
+    async def _reconnect(self) -> None:
+        try:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port
+            )
+        except OSError as e:
+            raise RpcConnectionError(f"reconnect to {self.host}:{self.port}: {e}") from None
+        if self._read_task is not None:
+            self._read_task.cancel()
+        self._pending.clear()
+        self._closed = False
+        self._send_lock = asyncio.Lock()
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        for channel in list(self._sub_callbacks):
+            try:
+                await self._call_once("__subscribe__", 2.0, {"channel": channel})
+            except (TimeoutError, RpcConnectionError):
+                pass
 
     async def _call_once(self, method: str, timeout: Optional[float], params: Dict) -> Any:
         if self._closed:
@@ -330,6 +361,7 @@ class RpcClient:
 
     async def close(self) -> None:
         self._closed = True
+        self._user_closed = True
         if self._read_task is not None:
             self._read_task.cancel()
         if self._writer is not None:
