@@ -113,7 +113,7 @@ class GcsServer:
         if self._persist_task:
             self._persist_task.cancel()
             if self.persist_dir:
-                self._write_snapshot()
+                self._write_snapshot(self._snapshot_state())
         if self._health_task:
             self._health_task.cancel()
         if self._gc_task:
@@ -930,12 +930,14 @@ class GcsServer:
     # agents re-register on heartbeat rejection and drivers reconnect, so a
     # restarted GCS resumes from the last snapshot.
     def _snapshot_state(self) -> Dict[str, Any]:
+        # Shallow-copies every mutable container so the dict can be serialized
+        # off the event loop while RPC handlers keep mutating live state.
         return {
-            "nodes": self.nodes,
-            "available": self.available,
-            "node_load": self.node_load,
-            "kv": self.kv,
-            "actors": self.actors,
+            "nodes": {n: dict(v) for n, v in self.nodes.items()},
+            "available": {n: dict(v) for n, v in self.available.items()},
+            "node_load": dict(self.node_load),
+            "kv": dict(self.kv),
+            "actors": {a: dict(v) for a, v in self.actors.items()},
             "named_actors": {f"{ns}\x00{name}": aid for (ns, name), aid
                              in self.named_actors.items()},
             "objects": {
@@ -945,20 +947,23 @@ class GcsServer:
                 for o, r in self.objects.items()
             },
             "object_holders": {o: sorted(h) for o, h in self.object_holders.items()},
-            "object_contains": self.object_contains,
-            "lineage": self.lineage,
-            "pgs": self.pgs,
+            "object_contains": {o: list(c) for o, c in self.object_contains.items()},
+            "lineage": {o: dict(v) for o, v in self.lineage.items()},
+            "pgs": {p: dict(v) for p, v in self.pgs.items()},
             "job_counter": self._job_counter,
         }
 
-    def _write_snapshot(self) -> None:
+    def _write_snapshot(self, state: Dict[str, Any]) -> None:
         import msgpack
 
         os.makedirs(self.persist_dir, exist_ok=True)
         path = os.path.join(self.persist_dir, "gcs_snapshot.msgpack")
-        tmp = path + ".tmp"
+        # unique tmp per writer: stop()'s final on-loop write may race an
+        # in-flight executor write from _persist_loop; sharing one tmp name
+        # would interleave and publish a torn file
+        tmp = f"{path}.{os.getpid()}.{id(state):x}.tmp"
         with open(tmp, "wb") as f:
-            f.write(msgpack.packb(self._snapshot_state(), use_bin_type=True))
+            f.write(msgpack.packb(state, use_bin_type=True))
         os.replace(tmp, path)  # atomic: readers never see a torn snapshot
 
     def _restore_snapshot(self) -> None:
@@ -999,6 +1004,16 @@ class GcsServer:
         now = time.monotonic()
         for node_id in self.nodes:
             self.last_heartbeat[node_id] = now
+        # holders likewise: restored w:* holders whose processes died while the
+        # GCS was down must age out via the normal lease, so give each a fresh
+        # last-seen stamp (otherwise _reap_stale_holders never sees them and
+        # their objects stay pinned forever). Only w:* process holders — obj:*
+        # containers never heartbeat (they'd be falsely reaped one lease later)
+        # and task:*@w:* pins already die with their process's holder.
+        for holders in self.object_holders.values():
+            for holder in holders:
+                if holder.startswith("w:"):
+                    self.holder_last_seen.setdefault(holder, now)
         logger.info(
             "restored GCS snapshot: %d nodes, %d actors, %d objects, %d kv",
             len(self.nodes), len(self.actors), len(self.objects), len(self.kv),
@@ -1008,8 +1023,11 @@ class GcsServer:
         while True:
             await asyncio.sleep(config.gcs_snapshot_interval_s)
             try:
+                # Copy state on the event loop (no concurrent mutation), then
+                # serialize + write off-loop.
+                state = self._snapshot_state()
                 await asyncio.get_running_loop().run_in_executor(
-                    None, self._write_snapshot
+                    None, self._write_snapshot, state
                 )
             except Exception:  # noqa: BLE001
                 logger.exception("snapshot write failed")

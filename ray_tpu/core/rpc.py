@@ -141,7 +141,18 @@ class RpcServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # Actively close live client connections: since 3.12 wait_closed()
+            # waits for every handler coroutine, so a connected client that
+            # never disconnects would hang a graceful stop forever.
+            for w in list(self._writer_locks):
+                try:
+                    w.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2.0)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
 
     @property
     def address(self) -> str:
@@ -234,6 +245,8 @@ class RpcClient:
         self._read_task: Optional[asyncio.Task] = None
         self._sub_callbacks: Dict[str, Callable[[Any], None]] = {}
         self._send_lock: Optional[asyncio.Lock] = None
+        self._reconnect_lock: Optional[asyncio.Lock] = None
+        self._conn_gen = 0
         self._closed = False
         self._user_closed = False
 
@@ -255,9 +268,11 @@ class RpcClient:
         return self
 
     async def _read_loop(self) -> None:
+        gen = self._conn_gen
+        reader = self._reader
         try:
             while True:
-                msg = await _read_frame(self._reader)
+                msg = await _read_frame(reader)
                 if "c" in msg:  # pubsub push
                     cb = self._sub_callbacks.get(msg["c"])
                     if cb is not None:
@@ -276,11 +291,15 @@ class RpcClient:
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
             pass
         finally:
-            self._closed = True
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(RpcConnectionError("connection lost"))
-            self._pending.clear()
+            # A stale read loop (superseded by _reconnect) must not clobber
+            # the live connection's state or fail its in-flight futures.
+            if gen == self._conn_gen:
+                self._closed = True
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(RpcConnectionError("connection lost"))
+                        fut.exception()  # caller may have timed out: mark retrieved
+                self._pending.clear()
 
     async def call(self, method: str, timeout: Any = DEFAULT_TIMEOUT, **params) -> Any:
         if timeout is DEFAULT_TIMEOUT:
@@ -320,23 +339,41 @@ class RpcClient:
         return await self._call_once(method, timeout, params)
 
     async def _reconnect(self) -> None:
-        try:
-            self._reader, self._writer = await asyncio.open_connection(
-                self.host, self.port
-            )
-        except OSError as e:
-            raise RpcConnectionError(f"reconnect to {self.host}:{self.port}: {e}") from None
-        if self._read_task is not None:
-            self._read_task.cancel()
-        self._pending.clear()
-        self._closed = False
-        self._send_lock = asyncio.Lock()
-        self._read_task = asyncio.ensure_future(self._read_loop())
-        for channel in list(self._sub_callbacks):
+        if self._reconnect_lock is None:
+            self._reconnect_lock = asyncio.Lock()
+        gen = self._conn_gen
+        async with self._reconnect_lock:
+            if self._user_closed:
+                # close() landed while we waited: never resurrect a client the
+                # application has shut down
+                raise RpcConnectionError("client closed")
+            if self._conn_gen != gen and not self._closed:
+                return  # a racing caller already reconnected; reuse its link
             try:
-                await self._call_once("__subscribe__", 2.0, {"channel": channel})
-            except (TimeoutError, RpcConnectionError):
-                pass
+                reader, writer = await asyncio.open_connection(self.host, self.port)
+            except OSError as e:
+                raise RpcConnectionError(
+                    f"reconnect to {self.host}:{self.port}: {e}"
+                ) from None
+            if self._read_task is not None:
+                self._read_task.cancel()
+            # In-flight futures belong to the dead connection: fail them (the
+            # retry loop re-sends) instead of dropping them to hang forever.
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(RpcConnectionError("connection lost"))
+                    fut.exception()  # caller may have timed out: mark retrieved
+            self._pending.clear()
+            self._reader, self._writer = reader, writer
+            self._closed = False
+            self._conn_gen += 1
+            self._send_lock = asyncio.Lock()
+            self._read_task = asyncio.ensure_future(self._read_loop())
+            for channel in list(self._sub_callbacks):
+                try:
+                    await self._call_once("__subscribe__", 2.0, {"channel": channel})
+                except (TimeoutError, RpcConnectionError):
+                    pass
 
     async def _call_once(self, method: str, timeout: Optional[float], params: Dict) -> Any:
         if self._closed:
@@ -344,9 +381,15 @@ class RpcClient:
         req_id = next(self._ids)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
-        async with self._send_lock:
-            self._writer.write(_pack({"i": req_id, "m": method, "p": params}))
-            await self._writer.drain()
+        try:
+            async with self._send_lock:
+                self._writer.write(_pack({"i": req_id, "m": method, "p": params}))
+                await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            # a half-open connection surfaces here as a raw OS error; translate
+            # so the retry-safe path reconnects instead of leaking it upward
+            self._pending.pop(req_id, None)
+            raise RpcConnectionError(f"send failed: {e}") from None
         try:
             if timeout is None:
                 return await fut  # infinite deadline (connection loss still errors)

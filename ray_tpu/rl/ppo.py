@@ -48,7 +48,10 @@ def gae_advantages(rewards, values, mask, gamma: float, lam: float):
     per-token Python loop."""
     b, t = rewards.shape
     next_values = jnp.concatenate([values[:, 1:], jnp.zeros((b, 1))], axis=1)
-    deltas = (rewards + gamma * next_values * mask - values) * mask
+    # Bootstrap with the validity of position t+1, not t: the last unmasked
+    # step must bootstrap from 0, not from V evaluated on padding.
+    next_mask = jnp.concatenate([mask[:, 1:], jnp.zeros((b, 1))], axis=1)
+    deltas = (rewards + gamma * next_values * next_mask - values) * mask
 
     def body(carry, xs):
         delta_t, mask_t = xs

@@ -43,17 +43,29 @@ def test_gae_matches_reference_loop():
     adv, ret = gae_advantages(jnp.asarray(rewards), jnp.asarray(values),
                               jnp.asarray(mask), gamma, lam)
 
-    # reference: explicit reverse loop
+    # reference: explicit reverse loop. The bootstrap term uses the validity
+    # of position t+1 — the last unmasked step bootstraps from 0, never from
+    # V evaluated on a padding token.
     expected = np.zeros((B, T), np.float32)
     for b in range(B):
         carry = 0.0
         for t in reversed(range(T)):
             nv = values[b, t + 1] if t + 1 < T else 0.0
-            delta = (rewards[b, t] + gamma * nv * mask[b, t] - values[b, t]) * mask[b, t]
+            nm = mask[b, t + 1] if t + 1 < T else 0.0
+            delta = (rewards[b, t] + gamma * nv * nm - values[b, t]) * mask[b, t]
             carry = delta + gamma * lam * mask[b, t] * carry
             expected[b, t] = carry * mask[b, t]
     np.testing.assert_allclose(np.asarray(adv), expected, atol=1e-5)
     np.testing.assert_allclose(np.asarray(ret), expected + values * mask, atol=1e-5)
+
+    # the last valid step of the masked row must not absorb V(padding):
+    # its advantage equals r - V exactly (delta with zero bootstrap)
+    t_last = 3  # mask[1, 4:] == 0
+    np.testing.assert_allclose(
+        np.asarray(adv)[1, t_last],
+        rewards[1, t_last] - values[1, t_last],
+        atol=1e-5,
+    )
 
 
 def test_logprob_fn_matches_softmax():
