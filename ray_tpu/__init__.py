@@ -36,6 +36,7 @@ from ray_tpu.api import (
 from ray_tpu.core.actor import ActorClass, ActorHandle, method
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.streaming import ObjectRefGenerator
 from ray_tpu import exceptions
 
 __all__ = [
@@ -43,6 +44,7 @@ __all__ = [
     "ActorClass",
     "ActorHandle",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RemoteFunction",
     "available_resources",
     "cancel",

@@ -29,18 +29,27 @@ _VALID_ACTOR_OPTIONS = {
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1,
+                 generator_backpressure: Optional[int] = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._generator_backpressure = generator_backpressure
 
     def options(self, **opts) -> "ActorMethod":
         return ActorMethod(
-            self._handle, self._method_name, num_returns=opts.get("num_returns", self._num_returns)
+            self._handle, self._method_name,
+            num_returns=opts.get("num_returns", self._num_returns),
+            generator_backpressure=opts.get(
+                "_generator_backpressure", self._generator_backpressure
+            ),
         )
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
-        return self._handle._submit(self._method_name, args, kwargs, self._num_returns)
+        return self._handle._submit(
+            self._method_name, args, kwargs, self._num_returns,
+            generator_backpressure=self._generator_backpressure,
+        )
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -58,11 +67,21 @@ class ActorHandle:
     def actor_id(self) -> ActorID:
         return self._actor_id
 
-    def _submit(self, method_name: str, args: tuple, kwargs: dict, num_returns: int):
+    def _submit(self, method_name: str, args: tuple, kwargs: dict, num_returns,
+                generator_backpressure: Optional[int] = None):
         worker = require_worker()
+        streaming = num_returns in ("streaming", "dynamic")
         task_id = TaskID.for_actor_task(self._actor_id)
         spec_args, spec_kwargs = build_task_args(args, kwargs)
         opts = self._actor_options
+        backpressure = 0
+        if streaming:
+            if generator_backpressure is not None:
+                backpressure = int(generator_backpressure)
+            else:
+                from ray_tpu.core.config import config
+
+                backpressure = int(config.generator_backpressure_items)
         spec = TaskSpec(
             task_id=task_id,
             job_id=worker.job_id,
@@ -71,7 +90,7 @@ class ActorHandle:
             function=FunctionDescriptor(module="", qualname=method_name, function_id=""),
             args=spec_args,
             kwargs=spec_kwargs,
-            num_returns=num_returns,
+            num_returns=1 if streaming else num_returns,
             resources=build_resources({"num_cpus": 0}, default_num_cpus=0),
             strategy=resolve_strategy({}),
             owner_worker=worker.worker_id,
@@ -79,8 +98,14 @@ class ActorHandle:
             actor_method_name=method_name,
             max_task_retries=opts.get("max_task_retries", 0),
             max_pending_calls=opts.get("max_pending_calls", -1),
+            generator=streaming,
+            generator_backpressure=backpressure,
         )
         refs = worker.runtime.submit_actor_task(self._actor_id, spec, args, kwargs)
+        if streaming:
+            from ray_tpu.core.streaming import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id.binary().hex(), worker.runtime)
         if num_returns == 1:
             return refs[0]
         return refs

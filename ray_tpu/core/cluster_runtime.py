@@ -209,6 +209,37 @@ class ClusterRuntime(CoreRuntime):
     def free(self, refs: Sequence[ObjectRef]) -> None:
         self.agent.call("free_objects", object_ids=[r.id.hex() for r in refs])
 
+    # ------------------------------------------------- streaming generators
+    def stream_next(self, task_hex: str, index: int, timeout: Optional[float]):
+        """Long-poll the GCS stream directory in bounded chunks (same pattern
+        as get(): a dropped frame costs one chunk, not the whole deadline)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise exc.GetTimeoutError(
+                    f"stream item {index} of {task_hex[:16]} not ready in {timeout}s"
+                )
+            attempt_s = 5.0 if remaining is None else min(remaining, 5.0)
+            try:
+                resp = self.gcs.call(
+                    "stream_next", task_id=task_hex, index=index,
+                    timeout=attempt_s + 5.0, timeout_s=attempt_s,
+                )
+            except TimeoutError:
+                continue
+            if resp.get("timeout"):
+                continue
+            if "end" in resp:
+                return ("end", resp["end"])
+            return ("item", resp["object_id"])
+
+    def stream_close(self, task_hex: str) -> None:
+        try:
+            self.gcs.call("stream_close", task_id=task_hex)
+        except Exception:  # noqa: BLE001 - teardown path
+            pass
+
     # ------------------------------------------------- distributed ref counts
     def _start_ref_flusher(self) -> None:
         with self._ref_lock:
@@ -307,7 +338,7 @@ class ClusterRuntime(CoreRuntime):
 
     def _spec_dict(self, spec: TaskSpec, args: tuple, kwargs: dict) -> Dict[str, Any]:
         payload, _refs = serialization.pack((args, kwargs))
-        return {
+        sd = {
             "runtime_env": self._prepare_runtime_env(spec.runtime_env),
             "task_id": spec.task_id.binary().hex(),
             "name": spec.name,
@@ -320,6 +351,10 @@ class ClusterRuntime(CoreRuntime):
             "max_retries": spec.max_retries,
             "retry_exceptions": spec.retry_exceptions,
         }
+        if spec.generator:
+            sd["streaming"] = True
+            sd["backpressure"] = spec.generator_backpressure
+        return sd
 
     def submit_task(self, spec: TaskSpec, func: Any, args: tuple, kwargs: dict) -> List[ObjectRef]:
         self._export_function(spec.function.function_id, func)
@@ -328,6 +363,11 @@ class ClusterRuntime(CoreRuntime):
         # a task holder) BEFORE accepting — see agent.rpc_submit_task
         sd["holder"] = self.client_id
         self.agent.call("submit_task", spec=sd)
+        if spec.generator:
+            # dynamic returns: item holders are registered at stream_put time;
+            # materializing refs here would add-then-del the submitter holder
+            # on item 0 and free it before the consumer ever sees it
+            return []
         return [ObjectRef(oid) for oid in spec.return_ids()]
 
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
@@ -391,8 +431,10 @@ class ClusterRuntime(CoreRuntime):
             return client
 
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec, args, kwargs) -> List[ObjectRef]:
-        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        refs = [] if spec.generator else [ObjectRef(oid) for oid in spec.return_ids()]
         sd = self._spec_dict(spec, args, kwargs)
+        if spec.generator:
+            sd["holder"] = self.client_id
         # pin deps+returns for the in-flight call (released when the push
         # completes in _push_actor_task) and register this process's holder on
         # the returns — synchronously, while the caller's arg refs are live.

@@ -148,6 +148,10 @@ _DEFINITIONS = [
      "Timeout for a worker-lease request before retrying elsewhere."),
     ("max_pending_lease_requests_per_key", 10, int,
      "Pipelined lease requests per scheduling key."),
+    ("generator_backpressure_items", 16, int,
+     "Streaming generators: max items produced ahead of the consumer before "
+     "the producer blocks (0 = unlimited). Per-task override via "
+     "_generator_backpressure option."),
     # --- workers ---
     ("num_workers_per_node", 0, int,
      "Worker processes per node (0 = num_cpus)."),

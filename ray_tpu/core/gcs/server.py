@@ -84,6 +84,13 @@ class GcsServer:
         # w:* process holders renew a lease via heartbeat; silence beyond
         # object_holder_lease_s = crashed process, drop its holders.
         self.holder_last_seen: Dict[str, float] = {}
+        # streaming generators: task hex -> stream record (items produced so
+        # far, end marker, consumer watermark) — reference capability:
+        # _raylet.pyx ObjectRefGenerator report paths (:1206,1263), here a
+        # GCS-centralized stream directory beside the object directory
+        self.streams: Dict[str, Dict[str, Any]] = {}
+        # one-shot stream items: freed with a short grace once holder-less
+        self._fast_free: Set[str] = set()
         self._gc_task: Optional[asyncio.Task] = None
         self._persist_task: Optional[asyncio.Task] = None
         self._schedule_calls = 0  # batched RPCs received
@@ -844,6 +851,159 @@ class GcsServer:
     async def rpc_object_ref_counts(self, object_ids: List[str]) -> Dict[str, int]:
         return {o: len(self.object_holders.get(o, ())) for o in object_ids}
 
+    # ------------------------------------------------- streaming generators
+    def _stream(self, task_id: str) -> Dict[str, Any]:
+        rec = self.streams.get(task_id)
+        if rec is None:
+            rec = {
+                "items": {},        # index -> object id hex
+                "finished": False,
+                "total": 0,
+                "consumed": 0,      # consumer watermark: next index wanted
+                "closed": False,
+                "waiters": [],      # futures woken on any state change
+                "updated": time.monotonic(),
+            }
+            self.streams[task_id] = rec
+        return rec
+
+    @staticmethod
+    def _stream_wake(rec: Dict[str, Any]) -> None:
+        waiters, rec["waiters"] = rec["waiters"], []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+        rec["updated"] = time.monotonic()
+
+    async def _stream_changed(self, rec: Dict[str, Any], chunk_s: float) -> None:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        rec["waiters"].append(fut)
+        try:
+            await asyncio.wait_for(fut, chunk_s)
+        except (asyncio.TimeoutError, TimeoutError):
+            pass
+
+    @staticmethod
+    def _stream_holder(task_id: str) -> str:
+        return f"stream:{task_id}"
+
+    async def rpc_stream_put(self, task_id: str, index: int, object_id: str) -> Dict[str, Any]:
+        """Producer reports item ``index``. The item is pinned under the
+        stream's own holder (dynamic return ids can't be pinned at submit
+        time); that pin is dropped as the consumer watermark passes the item,
+        leaving only the consumer's ref — so consumed-and-dropped items free
+        promptly while kept refs stay valid. Returns the consumer watermark
+        for backpressure."""
+        rec = self._stream(task_id)
+        rec["items"][index] = object_id
+        await self.rpc_add_object_refs([object_id], self._stream_holder(task_id))
+        # one-shot stream items use a short free grace once their holders
+        # empty: a 1,000-item stream must not accumulate a full ref-grace
+        # window of consumed items in the store
+        self._fast_free.add(object_id)
+        self._stream_wake(rec)
+        return {"consumed": rec["consumed"], "closed": rec["closed"]}
+
+    async def rpc_stream_end(self, task_id: str, total: int) -> bool:
+        rec = self._stream(task_id)
+        rec["finished"] = True
+        rec["total"] = total
+        self._stream_wake(rec)
+        return True
+
+    async def rpc_stream_state(self, task_id: str) -> Dict[str, Any]:
+        """Producer-side introspection (used by agents to report a failure at
+        the correct index of a partially-produced stream)."""
+        rec = self.streams.get(task_id)
+        if rec is None:
+            return {"produced": 0, "finished": False, "consumed": 0}
+        return {"produced": len(rec["items"]), "finished": rec["finished"],
+                "consumed": rec["consumed"]}
+
+    async def rpc_stream_next(self, task_id: str, index: int,
+                              timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Consumer long-poll for item ``index``; asking for index i doubles
+        as the consumed-watermark update (items < i acknowledged), which is
+        what producer backpressure waits on."""
+        rec = self._stream(task_id)
+        if index > rec["consumed"]:
+            old = rec["consumed"]
+            rec["consumed"] = index
+            # the consumer has items < index in hand (its own ref holders
+            # flush within the ref-sync interval, well inside the free
+            # grace): drop the stream pin so consumed items can free
+            passed = [rec["items"][j] for j in range(old, index) if j in rec["items"]]
+            if passed:
+                await self.rpc_remove_object_refs(passed, self._stream_holder(task_id))
+            self._stream_wake(rec)  # unblock a producer waiting on capacity
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            if index in rec["items"]:
+                return {"object_id": rec["items"][index]}
+            if rec["finished"]:
+                return {"end": rec["total"]}
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return {"timeout": True}
+            chunk = 5.0 if remaining is None else min(remaining, 5.0)
+            await self._stream_changed(rec, chunk)
+
+    async def rpc_stream_wait(self, task_id: str, index: int, max_ahead: int,
+                              timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Producer backpressure gate: block until producing item ``index``
+        would be < max_ahead items past the consumer, or the stream closed."""
+        rec = self._stream(task_id)
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while (index - rec["consumed"]) >= max_ahead and not rec["closed"]:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return {"timeout": True, "closed": rec["closed"]}
+            await self._stream_changed(rec, 5.0 if remaining is None else min(remaining, 5.0))
+        return {"closed": rec["closed"], "consumed": rec["consumed"]}
+
+    async def rpc_stream_close(self, task_id: str) -> bool:
+        """Consumer abandoned the stream: stop the producer and release the
+        submitter's holders on items it never consumed."""
+        rec = self.streams.get(task_id)
+        if rec is None:
+            # record the closure so a producer's later put/wait sees it
+            rec = self._stream(task_id)
+        rec["closed"] = True
+        unconsumed = [oid for idx, oid in rec["items"].items()
+                      if idx >= rec["consumed"]]
+        if unconsumed:
+            await self.rpc_remove_object_refs(unconsumed, self._stream_holder(task_id))
+        self._stream_wake(rec)
+        return True
+
+    def _reap_streams(self) -> None:
+        """Drop stream records that can no longer matter: fully consumed,
+        or closed/abandoned and idle past the holder lease."""
+        now = time.monotonic()
+        stale = now - config.object_holder_lease_s
+        doomed = [
+            t for t, rec in self.streams.items()
+            if not rec["waiters"] and (
+                # fully consumed: linger briefly so a retried final
+                # stream_next still sees the end marker instead of a
+                # recreated empty record
+                (rec["finished"] and rec["consumed"] >= rec["total"]
+                 and rec["updated"] < now - 5.0)
+                or (rec["closed"] and rec["updated"] < stale)
+                or rec["updated"] < now - 10 * config.object_holder_lease_s
+            )
+        ]
+        for t in doomed:
+            rec = self.streams.pop(t)
+            # abandoned/finished streams must not pin items forever
+            holder = self._stream_holder(t)
+            for oid in rec["items"].values():
+                holders = self.object_holders.get(oid)
+                if holders and holder in holders:
+                    holders.discard(holder)
+                    if not holders:
+                        self._pending_free[oid] = now
+
     async def _gc_loop(self) -> None:
         """Free objects whose cluster-wide holder set has been empty for a
         full grace window (the window absorbs in-flight ref handoffs: a
@@ -853,14 +1013,23 @@ class GcsServer:
         while True:
             await asyncio.sleep(min(0.25, config.object_ref_grace_s / 4))
             self._reap_stale_holders()
+            self._reap_streams()
             if not self._pending_free:
                 continue
-            cutoff = time.monotonic() - config.object_ref_grace_s
-            expired = [o for o, t in self._pending_free.items() if t <= cutoff]
+            now = time.monotonic()
+            cutoff = now - config.object_ref_grace_s
+            # stream items get a short grace: the only handoff to absorb is
+            # the consumer's ref-sync flush (~ref_sync_interval_s)
+            fast_cutoff = now - max(0.25, 5 * config.ref_sync_interval_s)
+            expired = [
+                o for o, t in self._pending_free.items()
+                if t <= (fast_cutoff if o in self._fast_free else cutoff)
+            ]
             for object_id in expired:
                 if self.object_holders.get(object_id):
                     self._pending_free.pop(object_id, None)
                     continue  # a holder came back during the grace window
+                self._fast_free.discard(object_id)
                 await self._free_everywhere(object_id)
 
     def _reap_stale_holders(self) -> None:

@@ -211,7 +211,7 @@ class _LocalActor:
         self.mailbox: "queue.Queue[Optional[_ActorCall]]" = queue.Queue()
         self.num_pending = 0
         self.is_async = any(
-            asyncio.iscoroutinefunction(m)
+            asyncio.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
             for _, m in inspect.getmembers(cls, predicate=inspect.isfunction)
         )
         self.max_concurrency = max(1, spec.max_concurrency)
@@ -260,7 +260,10 @@ class _LocalActor:
             call = self.mailbox.get()
             if call is None:
                 break
-            if self.is_async and asyncio.iscoroutinefunction(getattr(self.cls, call.func_name, None)):
+            mfn = getattr(self.cls, call.func_name, None)
+            if self.is_async and (
+                asyncio.iscoroutinefunction(mfn) or inspect.isasyncgenfunction(mfn)
+            ):
                 sem.acquire()
                 fut = asyncio.run_coroutine_threadsafe(self._run_async(call), self._loop)
                 fut.add_done_callback(lambda _f: sem.release())
@@ -299,6 +302,7 @@ class _LocalActor:
             err = self.death_cause or exc.ActorDiedError(self.actor_id.hex(), "actor is dead")
             for oid in call.return_ids:
                 self.runtime._store.seal(oid, error=err)
+            self.runtime._stream_mark_error(call.spec)
             w = global_worker()
             if w is not None:
                 for dep in call.spec.dependencies():
@@ -378,6 +382,8 @@ class LocalRuntime(CoreRuntime):
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
         self._actor_lock = threading.Lock()
         self._pgs: Dict[PlacementGroupID, _PlacementGroup] = {}
+        # streaming generators: task hex -> in-process stream directory entry
+        self._streams: Dict[str, Any] = {}
         self._shutdown = False
         self._started_at = time.time()
         # Reusable executor threads (the WorkerPool analogue). Sized well
@@ -455,7 +461,13 @@ class LocalRuntime(CoreRuntime):
                 f"{dict(self._pool.total)}"
             )
         _TASKS_SUBMITTED.inc()
-        return_refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        if spec.generator:
+            from ray_tpu.core.streaming import LocalStreamState
+
+            self._streams[spec.task_id.binary().hex()] = LocalStreamState()
+            return_refs: List[ObjectRef] = []
+        else:
+            return_refs = [ObjectRef(oid) for oid in spec.return_ids()]
         task = _PendingTask(spec=spec, func=func, args=args, kwargs=kwargs)
         self._tasks[spec.task_id] = task
         w = global_worker()
@@ -513,6 +525,7 @@ class LocalRuntime(CoreRuntime):
                             err = exc.TaskCancelledError(task.spec.task_id.hex())
                             for oid in task.spec.return_ids():
                                 self._store.seal(oid, error=err)
+                            self._stream_mark_error(task.spec)
                             self._tasks.pop(task.spec.task_id, None)
                             dispatched_one = True
                             break
@@ -554,32 +567,38 @@ class LocalRuntime(CoreRuntime):
                     err: BaseException = exc.TaskCancelledError(spec.task_id.hex())
                     for oid in return_ids:
                         self._store.seal(oid, error=err)
+                    self._stream_mark_error(spec)
                     _TASKS_FINISHED.inc(tags={"state": "cancelled"})
                     return
                 r_args, r_kwargs, dep_err = self._resolve_args(task.args, task.kwargs)
                 if dep_err is not None:
                     for oid in return_ids:
                         self._store.seal(oid, error=dep_err)
+                    self._stream_mark_error(spec)
                     _TASKS_FINISHED.inc(tags={"state": "dep_failed"})
                     return
                 w.set_task_context(spec.task_id, None, spec.name, attempt=attempts)
                 start = time.monotonic()
                 try:
                     result = task.func(*r_args, **r_kwargs)
+                    if spec.generator:
+                        self._drive_generator(spec, result)
+                    else:
+                        self._store_returns(spec, return_ids, result)
                     _TASK_EXEC_SECONDS.observe(time.monotonic() - start)
-                    self._store_returns(spec, return_ids, result)
                     _TASKS_FINISHED.inc(tags={"state": "ok"})
                     return
                 except BaseException as e:  # noqa: BLE001
                     attempts += 1
                     retryable = spec.retry_exceptions and attempts <= spec.max_retries
-                    if retryable:
+                    if retryable and not spec.generator:
                         logger.info("Task %s failed (attempt %d), retrying: %s", spec.name, attempts, e)
                         continue
                     err = exc.TaskError.from_exception(e, spec.name, pid=os.getpid(),
                                                        node_id=self.node_id.hex())
                     for oid in return_ids:
                         self._store.seal(oid, error=err)
+                    self._stream_mark_error(spec)
                     _TASKS_FINISHED.inc(tags={"state": "error"})
                     return
                 finally:
@@ -612,6 +631,118 @@ class LocalRuntime(CoreRuntime):
         for oid, v in zip(return_ids, result):
             self._store.seal(oid, value=v)
 
+    # ------------------------------------------------------------- streaming
+    def _drive_generator(self, spec: TaskSpec, result: Any) -> None:
+        """Producer side of num_returns='streaming': seal each yielded item
+        as its own object, report it to the stream directory, respect
+        consumer backpressure. Mid-stream exceptions become an error ITEM
+        followed by end-of-stream (no retries of partially-consumed streams)."""
+        import inspect
+
+        from ray_tpu.core.streaming import stream_item_id
+
+        task_hex = spec.task_id.binary().hex()
+        st = self._streams.get(task_hex)
+        if inspect.isasyncgen(result):
+            from ray_tpu.core.streaming import iter_async_gen
+
+            result = iter_async_gen(result)
+        elif not inspect.isgenerator(result):
+            raise TypeError(
+                f"num_returns='streaming' requires a generator function; "
+                f"{spec.name} returned {type(result).__name__}"
+            )
+        if st is None:  # stream already closed+reaped before execution began
+            result.close()
+            return
+        idx = 0
+        try:
+            for item in result:
+                oid = stream_item_id(task_hex, idx)
+                self._store.seal(oid, value=item)
+                alive = st.put(idx, oid.hex(), spec.generator_backpressure)
+                idx += 1
+                if not alive:
+                    result.close()
+                    break
+        except BaseException as e:  # noqa: BLE001 - delivered as an error item
+            err = exc.TaskError.from_exception(e, spec.name, pid=os.getpid(),
+                                               node_id=self.node_id.hex())
+            oid = stream_item_id(task_hex, idx)
+            self._store.seal(oid, error=err)
+            st.put(idx, oid.hex(), 0)
+            st.end(idx + 1)
+            return
+        st.end(idx)
+
+    async def _drive_async_generator(self, spec: TaskSpec, agen: Any) -> None:
+        """Async-actor variant of _drive_generator (async-generator methods).
+        Backpressure waits run off-loop so other coroutine calls proceed."""
+        from ray_tpu.core.streaming import stream_item_id
+
+        task_hex = spec.task_id.binary().hex()
+        st = self._streams.get(task_hex)
+        if st is None:
+            await agen.aclose()
+            return
+        loop = asyncio.get_running_loop()
+        idx = 0
+        try:
+            async for item in agen:
+                oid = stream_item_id(task_hex, idx)
+                self._store.seal(oid, value=item)
+                alive = await loop.run_in_executor(
+                    None, st.put, idx, oid.hex(), spec.generator_backpressure
+                )
+                idx += 1
+                if not alive:
+                    await agen.aclose()
+                    break
+        except BaseException as e:  # noqa: BLE001 - delivered as an error item
+            err = exc.TaskError.from_exception(e, spec.name, pid=os.getpid(),
+                                               node_id=self.node_id.hex())
+            oid = stream_item_id(task_hex, idx)
+            self._store.seal(oid, error=err)
+            st.put(idx, oid.hex(), 0)
+            st.end(idx + 1)
+            return
+        st.end(idx)
+
+    def _stream_mark_error(self, spec: TaskSpec) -> None:
+        """A pre-execution failure sealed error objects into the fixed
+        returns; surface it to a streaming consumer as item 0 + end."""
+        if not spec.generator:
+            return
+        st = self._streams.get(spec.task_id.binary().hex())
+        if st is None or st.finished:
+            return
+        st.put(0, spec.return_ids()[0].hex(), 0)
+        st.end(1)
+
+    def stream_next(self, task_hex: str, index: int, timeout: Optional[float]):
+        st = self._streams.get(task_hex)
+        if st is None:
+            raise ValueError(f"unknown or closed stream {task_hex[:16]}")
+        try:
+            kind, value = st.next(index, timeout)
+        except TimeoutError:
+            raise exc.GetTimeoutError(
+                f"stream item {index} of {task_hex[:16]} not ready in {timeout}s"
+            ) from None
+        if kind == "end" and index >= value:
+            self._streams.pop(task_hex, None)  # fully consumed: reap state
+        return kind, value
+
+    def stream_close(self, task_hex: str) -> None:
+        st = self._streams.pop(task_hex, None)
+        if st is None:
+            return
+        st.close()
+        with st.cond:
+            for idx, oid_hex in st.items.items():
+                if idx >= st.delivered:  # never handed to the consumer
+                    self._store.free(ObjectID.from_hex(oid_hex))
+
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
         task = self._tasks.get(ref.id.task_id())
         if task is None:
@@ -628,6 +759,7 @@ class LocalRuntime(CoreRuntime):
             err = exc.TaskCancelledError(task.spec.task_id.hex())
             for oid in task.spec.return_ids():
                 self._store.seal(oid, error=err)
+            self._stream_mark_error(task.spec)
             with self._pending_lock:
                 if task in self._pending:
                     self._pending.remove(task)
@@ -668,11 +800,18 @@ class LocalRuntime(CoreRuntime):
 
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec, args: tuple, kwargs: dict) -> List[ObjectRef]:
         actor = self._actors.get(actor_id)
-        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        if spec.generator:
+            from ray_tpu.core.streaming import LocalStreamState
+
+            self._streams[spec.task_id.binary().hex()] = LocalStreamState()
+            refs: List[ObjectRef] = []
+        else:
+            refs = [ObjectRef(oid) for oid in spec.return_ids()]
         if actor is None:
             err = exc.ActorDiedError(actor_id.hex(), "unknown or shut down actor")
-            for r in refs:
-                self._store.seal(r.id, error=err)
+            for oid in spec.return_ids():
+                self._store.seal(oid, error=err)
+            self._stream_mark_error(spec)
             return refs
         if spec.max_pending_calls > 0 and actor.mailbox.qsize() >= spec.max_pending_calls:
             raise exc.PendingCallsLimitExceededError(
@@ -685,8 +824,9 @@ class LocalRuntime(CoreRuntime):
         call = _ActorCall(spec, spec.actor_method_name, args, kwargs)
         if actor.state == "DEAD":
             err = actor.death_cause or exc.ActorDiedError(actor_id.hex(), "actor is dead")
-            for r in refs:
-                self._store.seal(r.id, error=err)
+            for oid in spec.return_ids():
+                self._store.seal(oid, error=err)
+            self._stream_mark_error(spec)
             return refs
         actor.mailbox.put(call)
         # Re-check after enqueue: if the actor died between the check and the
@@ -704,6 +844,7 @@ class LocalRuntime(CoreRuntime):
         if dep_err is not None:
             for oid in call.return_ids:
                 self._store.seal(oid, error=dep_err)
+            self._stream_mark_error(spec)
             for dep in spec.dependencies():
                 w.ref_counter.remove_submitted(dep)
             return
@@ -712,12 +853,16 @@ class LocalRuntime(CoreRuntime):
         try:
             method = getattr(actor.instance, call.func_name)
             result = method(*r_args, **r_kwargs)
+            if spec.generator:
+                self._drive_generator(spec, result)
+            else:
+                self._store_returns(spec, call.return_ids, result)
             _TASK_EXEC_SECONDS.observe(time.monotonic() - start)
-            self._store_returns(spec, call.return_ids, result)
         except BaseException as e:  # noqa: BLE001
             err = exc.TaskError.from_exception(e, spec.name, pid=os.getpid(), node_id=self.node_id.hex())
             for oid in call.return_ids:
                 self._store.seal(oid, error=err)
+            self._stream_mark_error(spec)
             if isinstance(e, (SystemExit, KeyboardInterrupt)):
                 actor.kill()
         finally:
@@ -741,14 +886,26 @@ class LocalRuntime(CoreRuntime):
                 w.ref_counter.remove_submitted(dep)
             return
         try:
+            import inspect
+
             method = getattr(actor.instance, call.func_name)
             w.set_task_context(spec.task_id, actor.actor_id, spec.name)
-            result = await method(*r_args, **r_kwargs)
-            self._store_returns(spec, call.return_ids, result)
+            if spec.generator and inspect.isasyncgenfunction(method):
+                await self._drive_async_generator(spec, method(*r_args, **r_kwargs))
+            else:
+                result = await method(*r_args, **r_kwargs)
+                if spec.generator:
+                    # run the (sync) generator off-loop: its body is user code
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._drive_generator, spec, result
+                    )
+                else:
+                    self._store_returns(spec, call.return_ids, result)
         except BaseException as e:  # noqa: BLE001
             err = exc.TaskError.from_exception(e, spec.name, pid=os.getpid(), node_id=self.node_id.hex())
             for oid in call.return_ids:
                 self._store.seal(oid, error=err)
+            self._stream_mark_error(spec)
         finally:
             w.set_task_context(None)
             for dep in spec.dependencies():

@@ -1214,21 +1214,51 @@ class NodeAgent:
         else:
             err = cls(message)
         payload, _ = serialization.pack(err)
-        for object_id in spec.get("returns", []):
-            oid = ObjectID.from_hex(object_id)
+        if spec.get("streaming") and spec.get("task_id"):
+            # a streaming consumer blocks on the stream directory, not the
+            # fixed returns: surface the failure as an error ITEM at the
+            # first unproduced index + end-of-stream. Never at index 0
+            # blindly — a worker crash after items 0..k were produced (and
+            # possibly consumed) must not truncate the stream into a
+            # successful-looking end (the error would be invisible).
+            tid = spec["task_id"]
             try:
-                self.store.reserve(oid, len(payload))
-                writer = ShmWriter(oid, len(payload), self.hex)
-                writer.buffer[:] = payload
-                writer.seal()
-                self.store.seal(oid)
-                self.error_objects.add(object_id)
+                st = await self.gcs.call("stream_state", task_id=tid)
+                if st.get("finished"):
+                    return  # stream already ended (e.g. producer reported)
+                nxt = int(st.get("produced", 0))
+                from ray_tpu.core.streaming import stream_item_id
+
+                err_hex = stream_item_id(tid, nxt).hex()
+                self._write_error_object(err_hex, payload)
+                await self.gcs.call(
+                    "register_object", object_id=err_hex, size=len(payload),
+                    node_id=self.hex, owner=":error",
+                )
+                await self.gcs.call("stream_put", task_id=tid, index=nxt,
+                                    object_id=err_hex)
+                await self.gcs.call("stream_end", task_id=tid, total=nxt + 1)
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to report stream error")
+            return
+        for object_id in spec.get("returns", []):
+            try:
+                self._write_error_object(object_id, payload)
                 await self.gcs.call(
                     "register_object", object_id=object_id, size=len(payload),
                     node_id=self.hex, owner=":error",
                 )
             except FileExistsError:
                 pass  # a retry already stored a result
+
+    def _write_error_object(self, object_id: str, payload: bytes) -> None:
+        oid = ObjectID.from_hex(object_id)
+        self.store.reserve(oid, len(payload))
+        writer = ShmWriter(oid, len(payload), self.hex)
+        writer.buffer[:] = payload
+        writer.seal()
+        self.store.seal(oid)
+        self.error_objects.add(object_id)
 
     # ---------------------------------------------------------------- actors
     async def rpc_start_actor(self, spec: Dict[str, Any]) -> Dict[str, Any]:

@@ -199,6 +199,94 @@ class WorkerProcess:
                 self._store_value(r, err, is_error=True)
             except FileExistsError:
                 pass
+        if spec.get("streaming") and spec.get("returns"):
+            # surface the pre-iteration failure to the streaming consumer as
+            # item 0 (the fixed first return slot) followed by end-of-stream
+            try:
+                self._stream_report(spec, 0, spec["returns"][0])
+                self._runtime.gcs.call("stream_end", task_id=spec["task_id"], total=1)
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to report stream error")
+
+    # ------------------------------------------------- streaming generators
+    def _stream_report(self, spec: Dict[str, Any], index: int, oid_hex: str) -> Dict[str, Any]:
+        return self._runtime.gcs.call(
+            "stream_put", task_id=spec["task_id"], index=index, object_id=oid_hex,
+        )
+
+    def _sync_iter_async_gen(self, agen):
+        """Iterate an async generator from an executor thread by driving each
+        __anext__ on the worker's event loop."""
+        while True:
+            try:
+                yield asyncio.run_coroutine_threadsafe(
+                    agen.__anext__(), self._loop
+                ).result()
+            except StopAsyncIteration:
+                return
+
+    def _drive_streaming(self, spec: Dict[str, Any], gen: Any) -> Dict[str, Any]:
+        """Producer side of num_returns='streaming' on a cluster worker: seal
+        each yielded item via the normal object path, report it to the GCS
+        stream directory, honor consumer backpressure via stream_wait.
+        Mid-stream exceptions become an error item + end-of-stream.
+        (reference: _raylet.pyx:1206,1263 per-item report paths)"""
+        import inspect
+
+        from ray_tpu.core.streaming import stream_item_id
+
+        task_hex = spec["task_id"]
+        backpressure = int(spec.get("backpressure") or 0)
+        if inspect.isasyncgen(gen):
+            gen = self._sync_iter_async_gen(gen)
+        elif not inspect.isgenerator(gen):
+            self._store_error_returns(spec, TypeError(
+                f"num_returns='streaming' requires a generator function; "
+                f"{spec.get('name', '?')} returned {type(gen).__name__}"
+            ))
+            return {"state": "error"}
+        idx = 0
+        try:
+            for item in gen:
+                oid_hex = stream_item_id(task_hex, idx).hex()
+                try:
+                    self._store_value(oid_hex, item)
+                except FileExistsError:
+                    pass  # duplicate execution: item already stored
+                resp = self._stream_report(spec, idx, oid_hex)
+                idx += 1
+                if resp.get("closed"):
+                    gen.close()
+                    break
+                if backpressure > 0 and idx - resp.get("consumed", 0) >= backpressure:
+                    while True:
+                        try:
+                            r = self._runtime.gcs.call(
+                                "stream_wait", task_id=task_hex, index=idx,
+                                max_ahead=backpressure, timeout=10.0, timeout_s=5.0,
+                            )
+                        except TimeoutError:
+                            continue
+                        if r.get("timeout"):
+                            continue
+                        break
+                    if r.get("closed"):
+                        gen.close()
+                        break
+        except BaseException as e:  # noqa: BLE001 - delivered as an error item
+            err = exc.TaskError.from_exception(
+                e, spec.get("name", "?"), pid=os.getpid(), node_id=self.node_hex
+            )
+            oid_hex = stream_item_id(task_hex, idx).hex()
+            try:
+                self._store_value(oid_hex, err, is_error=True)
+            except FileExistsError:
+                pass
+            self._stream_report(spec, idx, oid_hex)
+            self._runtime.gcs.call("stream_end", task_id=task_hex, total=idx + 1)
+            return {"state": "error"}
+        self._runtime.gcs.call("stream_end", task_id=task_hex, total=idx)
+        return {"state": "ok"}
 
     # ------------------------------------------------------------- task rpc
     async def rpc_run_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -217,6 +305,8 @@ class WorkerProcess:
                 fn = self._load_function(spec["function_id"])
                 args, kwargs = self._resolve_args(spec["args_payload"])
                 result = fn(*args, **kwargs)
+                if spec.get("streaming"):
+                    return self._drive_streaming(spec, result)
                 self._store_returns(spec, result)
                 return {"state": "ok"}
             except BaseException as e:  # noqa: BLE001
@@ -305,6 +395,8 @@ class WorkerProcess:
             result = method(*args, **kwargs)
             if asyncio.iscoroutine(result):
                 result = asyncio.run_coroutine_threadsafe(result, self._loop).result()
+            if spec.get("streaming"):
+                return self._drive_streaming(spec, result)
             self._store_returns(spec, result)
             return {"state": "ok"}
         except BaseException as e:  # noqa: BLE001

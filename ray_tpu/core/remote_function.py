@@ -32,7 +32,7 @@ _VALID_TASK_OPTIONS = {
     "num_cpus", "num_tpus", "num_gpus", "resources", "memory", "num_returns",
     "max_retries", "retry_exceptions", "name", "scheduling_strategy",
     "runtime_env", "placement_group", "placement_group_bundle_index",
-    "max_calls", "_metadata",
+    "max_calls", "_metadata", "_generator_backpressure",
 }
 
 # Keyed by a weak reference to the function object itself: the cache entry
@@ -143,10 +143,11 @@ class RemoteFunction:
         merged = {**self._options, **new_options}
         return RemoteFunction(self._function, merged)
 
-    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef], "Any"]:
         worker = require_worker()
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
         task_id = TaskID.for_normal_task(worker.job_id)
         spec_args, spec_kwargs = build_task_args(args, kwargs)
         from ray_tpu.core.config import config
@@ -154,6 +155,11 @@ class RemoteFunction:
         max_retries = opts.get("max_retries")
         if max_retries is None:
             max_retries = config.task_max_retries_default
+        backpressure = 0
+        if streaming:
+            backpressure = int(
+                opts.get("_generator_backpressure", config.generator_backpressure_items)
+            )
         spec = TaskSpec(
             task_id=task_id,
             job_id=worker.job_id,
@@ -162,15 +168,21 @@ class RemoteFunction:
             function=self._descriptor,
             args=spec_args,
             kwargs=spec_kwargs,
-            num_returns=num_returns,
+            num_returns=1 if streaming else num_returns,
             resources=build_resources(opts),
             strategy=resolve_strategy(opts),
             owner_worker=worker.worker_id,
             max_retries=max_retries,
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             runtime_env=opts.get("runtime_env"),
+            generator=streaming,
+            generator_backpressure=backpressure,
         )
         refs = worker.runtime.submit_task(spec, self._function, args, kwargs)
+        if streaming:
+            from ray_tpu.core.streaming import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id.binary().hex(), worker.runtime)
         if num_returns == 1:
             return refs[0]
         return refs

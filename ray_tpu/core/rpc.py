@@ -89,6 +89,8 @@ RETRY_SAFE_METHODS = frozenset({
     "list_actors", "actor_started", "placement_group_info",
     "placement_group_table", "reserve_bundle", "return_bundle",
     "create_object", "seal_object", "abort_object", "store_error",
+    "stream_put", "stream_end", "stream_next", "stream_wait", "stream_close",
+    "stream_state",
     "submit_task", "worker_ready", "worker_blocked", "worker_unblocked",
     "__subscribe__",
 })
@@ -420,6 +422,7 @@ class SyncRpcClient:
 
     def __init__(self, address: str):
         self.address = address
+        self._stopped = False
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever, daemon=True, name="rpc-client")
         self._thread.start()
@@ -427,6 +430,12 @@ class SyncRpcClient:
         self._run(self._client.connect())
 
     def _run(self, coro, timeout: Optional[float] = None):
+        if self._stopped or not self._thread.is_alive():
+            # a submit to a stopped loop would hang forever (the coroutine
+            # never runs); teardown-path callers (e.g. generator __del__ at
+            # interpreter exit) must get an error instead
+            coro.close()
+            raise RpcConnectionError("client closed")
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         return fut.result(timeout)
 
@@ -441,4 +450,5 @@ class SyncRpcClient:
             self._run(self._client.close(), timeout=2)
         except Exception:
             pass
+        self._stopped = True
         self._loop.call_soon_threadsafe(self._loop.stop)

@@ -52,6 +52,17 @@ class CoreRuntime(abc.ABC):
     @abc.abstractmethod
     def submit_task(self, spec: TaskSpec, func: Any, args: tuple, kwargs: dict) -> List[ObjectRef]: ...
 
+    # --- streaming generators (num_returns="streaming") --------------------
+    def stream_next(self, task_hex: str, index: int, timeout: Optional[float]) -> Tuple[str, Any]:
+        """Block until stream item ``index`` exists or the stream ended.
+        Returns ("item", oid_hex) or ("end", total). Asking for index i
+        acknowledges consumption of items < i (backpressure watermark)."""
+        raise NotImplementedError
+
+    def stream_close(self, task_hex: str) -> None:
+        """Consumer abandoned the stream: unblock/stop the producer."""
+        raise NotImplementedError
+
     @abc.abstractmethod
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None: ...
 

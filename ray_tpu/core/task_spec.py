@@ -95,7 +95,11 @@ class TaskSpec:
     bundle_index: int = -1
     # observability
     submitted_at: float = field(default_factory=time.time)
+    # streaming generators (num_returns="streaming"): yield items sealed
+    # one at a time; backpressure = max unconsumed items before the producer
+    # blocks (0 = unlimited)
     generator: bool = False
+    generator_backpressure: int = 0
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i + 1) for i in range(self.num_returns)]

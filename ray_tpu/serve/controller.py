@@ -87,6 +87,20 @@ class ServeController:
         with self._lock:
             return list(self._apps)
 
+    def get_app_meta(self, app_name: str) -> Optional[Dict[str, Any]]:
+        """Routing-relevant deployment metadata (proxy reads ``stream`` to
+        pick buffered vs chunked responses)."""
+        with self._lock:
+            rec = self._apps.get(app_name)
+            if rec is None:
+                return None
+            dep = rec["deployment"]
+            return {
+                "name": dep.name,
+                "stream": bool(getattr(dep, "stream", False)),
+                "max_ongoing_requests": dep.max_ongoing_requests,
+            }
+
     def status(self) -> Dict[str, Any]:
         out = {}
         with self._lock:

@@ -36,6 +36,10 @@ class Deployment:
     autoscaling_config: Optional[AutoscalingConfig] = None
     user_config: Optional[Dict[str, Any]] = None
     health_check_period_s: float = 2.0
+    # stream=True: HTTP responses are sent chunked as the callable's
+    # generator yields (reference: serve/_private/proxy.py:542 streaming
+    # send_request_to_replica); python handles use .options(stream=True)
+    stream: bool = False
 
     def options(self, **kwargs) -> "Deployment":
         if "autoscaling_config" in kwargs and isinstance(kwargs["autoscaling_config"], dict):
@@ -68,6 +72,7 @@ def deployment(
     ray_actor_options: Optional[Dict[str, Any]] = None,
     autoscaling_config: Optional[Any] = None,
     user_config: Optional[Dict[str, Any]] = None,
+    stream: bool = False,
 ):
     """@serve.deployment decorator (reference: serve/api.py:deployment)."""
 
@@ -83,6 +88,7 @@ def deployment(
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling_config=autoscaling_config,
             user_config=user_config,
+            stream=stream,
         )
 
     if _func_or_class is not None:

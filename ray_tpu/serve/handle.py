@@ -59,21 +59,31 @@ class DeploymentResponse:
 
 
 class DeploymentHandle:
-    def __init__(self, controller, app_name: str, method: str = "__call__"):
+    def __init__(self, controller, app_name: str, method: str = "__call__",
+                 stream: bool = False):
         self._controller = controller
         self._app = app_name
         self._method = method
+        self._stream = stream
 
-    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
-        return DeploymentHandle(self._controller, self._app, method_name or self._method)
+    def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._controller, self._app, method_name or self._method,
+            stream=self._stream if stream is None else stream,
+        )
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self._controller, self._app, name)
+        return DeploymentHandle(self._controller, self._app, name, stream=self._stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = _router_for(self._controller, self._app)
+        if self._stream:
+            # generator of VALUES, yielded as the replica produces them
+            # (reference: handle.options(stream=True) -> DeploymentResponseGenerator)
+            return router.call_streaming(self._method, args, kwargs)
         ref, replica = router.route(self._method, args, kwargs)
         resp = DeploymentResponse(router, ref, replica)
         resp._method = self._method

@@ -45,6 +45,10 @@ class GenRequest:
     out_tokens: List[int] = field(default_factory=list)
     slot: int = -1
     pending_first: Any = None  # device scalar: first sampled token, unfetched
+    # streaming: tokens pushed here as decoded (None sentinel = done)
+    stream_q: Optional["queue.Queue"] = None
+    streamed: int = 0
+    cancelled: bool = False
 
 
 class LLMEngine:
@@ -111,6 +115,35 @@ class LLMEngine:
         result = req.future.result(timeout=timeout)
         return result
 
+    def generate_stream(self, tokens: List[int], max_tokens: int = 64,
+                        eos_token: Optional[int] = None,
+                        timeout: Optional[float] = None):
+        """Streaming generate: yields {"token": t} the moment each token is
+        decoded, then a final {"done": True, "ttft_s", "latency_s",
+        "num_tokens"} record. Abandoning the generator cancels the request
+        (its slot retires at the next decode step)."""
+        if len(tokens) + max_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt {len(tokens)} + max_tokens {max_tokens} exceeds "
+                f"max_seq_len {self.max_seq}"
+            )
+        req = GenRequest(tokens=list(tokens), max_tokens=max_tokens,
+                         eos_token=eos_token, future=Future())
+        req.stream_q = queue.Queue()
+        self._pending.put(req)
+        try:
+            while True:
+                tok = req.stream_q.get(timeout=timeout)
+                if tok is None:
+                    break
+                yield {"token": tok}
+            result = req.future.result(timeout=5.0)
+            yield {"done": True, "ttft_s": result["ttft_s"],
+                   "latency_s": result["latency_s"],
+                   "num_tokens": len(result["tokens"])}
+        finally:
+            req.cancelled = True  # no-op if already finished
+
     def stats(self) -> Dict[str, Any]:
         return {
             "slots": self.num_slots,
@@ -169,7 +202,17 @@ class LLMEngine:
             self._positions = self._positions.at[free].set(n)
             self._active = self._active.at[free].set(True)
 
+    def _push_stream(self, req: GenRequest) -> None:
+        """Forward newly-decoded tokens to a streaming consumer."""
+        if req.stream_q is None:
+            return
+        while req.streamed < len(req.out_tokens):
+            req.stream_q.put(req.out_tokens[req.streamed])
+            req.streamed += 1
+
     def _finished(self, req: GenRequest) -> bool:
+        if req.cancelled:
+            return True
         if len(req.out_tokens) >= req.max_tokens:
             return True
         if req.eos_token is not None and req.out_tokens and \
@@ -188,6 +231,9 @@ class LLMEngine:
         if req.eos_token is not None and req.eos_token in req.out_tokens:
             req.out_tokens = req.out_tokens[: req.out_tokens.index(req.eos_token) + 1]
         self._tokens_out += len(req.out_tokens)
+        self._push_stream(req)
+        if req.stream_q is not None:
+            req.stream_q.put(None)  # end-of-stream sentinel
         req.future.set_result({
             "tokens": req.out_tokens,
             "ttft_s": req.ttft_s,
@@ -223,6 +269,7 @@ class LLMEngine:
                     req.pending_first = None
                     req.ttft_s = now - req.submitted_at
                     req.out_tokens.append(int(first))
+                    self._push_stream(req)  # first token streams immediately
                 for slot, req in enumerate(self._slots):
                     if req is None:
                         continue
@@ -233,6 +280,7 @@ class LLMEngine:
                         req.out_tokens.append(int(t))
                         if self._finished(req):
                             break
+                    self._push_stream(req)
                     if self._finished(req):
                         self._retire(slot)
             except Exception:  # noqa: BLE001 - engine loop must survive
@@ -266,11 +314,24 @@ class LLMDeployment:
             max_seq_len=max_seq_len, temperature=temperature,
         )
 
-    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def __call__(self, request: Dict[str, Any]):
+        if request.get("stream"):
+            return self.generate_stream(request)
         return self.generate(request)
 
     def generate(self, request: Dict[str, Any]) -> Dict[str, Any]:
         return self.engine.generate(
+            tokens=request["tokens"],
+            max_tokens=int(request.get("max_tokens", 64)),
+            eos_token=request.get("eos_token"),
+            timeout=request.get("timeout"),
+        )
+
+    def generate_stream(self, request: Dict[str, Any]):
+        """Token-streaming generate: yields {"token": t} per decoded token
+        then a final {"done": True, ...} record. Route via a stream=True
+        deployment (HTTP chunks) or handle.options(stream=True)."""
+        return self.engine.generate_stream(
             tokens=request["tokens"],
             max_tokens=int(request.get("max_tokens", 64)),
             eos_token=request.get("eos_token"),

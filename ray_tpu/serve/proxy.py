@@ -19,6 +19,20 @@ from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("serve.proxy")
 
+_STREAM_DONE = object()
+_STREAM_ERR = object()
+
+
+def _encode_stream_item(item: Any) -> bytes:
+    if isinstance(item, bytes):
+        return item
+    if isinstance(item, str):
+        return item.encode()
+    try:
+        return json.dumps(item).encode() + b"\n"  # ndjson record per item
+    except TypeError:
+        return (str(item) + "\n").encode()
+
 
 class ProxyActor:
     """One per serve instance (head node). Routes /app_name/... -> app."""
@@ -28,6 +42,7 @@ class ProxyActor:
         self._host = host
         self._port = port
         self._routes: Dict[str, Any] = {}  # app -> Router (lazy)
+        self._stream_flags: Dict[str, Tuple[bool, float]] = {}  # app -> (stream, ts)
         self._ready = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread = threading.Thread(target=self._serve, daemon=True,
@@ -67,6 +82,14 @@ class ProxyActor:
                 method, path, headers, body = req
                 status, payload, ctype = await self._handle(method, path, headers, body)
                 keep = headers.get("connection", "").lower() != "close"
+                if status == b"STREAM":
+                    # payload is an async item queue: chunked transfer so the
+                    # client sees items the moment the replica yields them
+                    # (reference: proxy.py:542 streaming response path)
+                    await self._write_chunked(writer, payload, ctype, keep)
+                    if not keep:
+                        break
+                    continue
                 writer.write(
                     b"HTTP/1.1 " + status + b"\r\n"
                     b"Content-Type: " + ctype + b"\r\n"
@@ -142,9 +165,42 @@ class ProxyActor:
             arg = body.decode() if "text" in ctype else body
         else:
             arg = None
+        call_args = (arg,) if arg is not None else ()
+        if self._app_streams(app):
+            # hand the connection an item queue fed by a puller thread that
+            # drains the router's (synchronous) value stream. The writer owns
+            # a `closed` event: on client disconnect it stops the puller,
+            # which closes the value stream — running the router's and
+            # replica's finally blocks so ongoing-request accounting and the
+            # generator's backpressure producer are released, never leaked.
+            import queue as _queue
+
+            q: "_queue.Queue" = _queue.Queue(maxsize=64)
+            closed = threading.Event()
+
+            def pull() -> None:
+                stream = router.call_streaming("__call__", call_args, {})
+                try:
+                    for item in stream:
+                        if closed.is_set():
+                            return
+                        q.put(item)
+                    if not closed.is_set():
+                        q.put(_STREAM_DONE)
+                except BaseException as e:  # noqa: BLE001
+                    if not closed.is_set():
+                        try:
+                            q.put((_STREAM_ERR, e), timeout=1.0)
+                        except Exception:  # noqa: BLE001
+                            pass
+                finally:
+                    stream.close()
+
+            threading.Thread(target=pull, daemon=True, name="proxy-stream-pull").start()
+            return b"STREAM", (q, closed), b"application/x-ndjson"
         try:
             result = await loop.run_in_executor(
-                None, lambda: router.call("__call__", (arg,) if arg is not None else (), {})
+                None, lambda: router.call("__call__", call_args, {})
             )
         except Exception as e:  # noqa: BLE001 - surface as 500
             return b"500 Internal Server Error", str(e).encode(), b"text/plain"
@@ -169,3 +225,62 @@ class ProxyActor:
             r = Router(self._controller, app)
             self._routes[app] = r
         return r
+
+    def _app_streams(self, app: str) -> bool:
+        import time as _time
+
+        cached = self._stream_flags.get(app)
+        now = _time.monotonic()
+        if cached is not None and now - cached[1] < 2.0:
+            return cached[0]
+        import ray_tpu
+
+        try:
+            meta = ray_tpu.get(self._controller.get_app_meta.remote(app), timeout=10)
+        except Exception:  # noqa: BLE001
+            return cached[0] if cached else False
+        streams = bool(meta and meta.get("stream"))
+        # short TTL: a redeploy that flips `stream` takes effect within 2 s
+        self._stream_flags[app] = (streams, now)
+        return streams
+
+    async def _write_chunked(self, writer: asyncio.StreamWriter, payload,
+                             ctype: bytes, keep: bool) -> None:
+        """Chunked-transfer response: one HTTP chunk per stream item, flushed
+        immediately — tokens reach the client before generation finishes.
+        On client disconnect the puller is stopped and its stream closed so
+        no thread or replica ongoing-slot leaks."""
+        q, closed = payload
+        loop = asyncio.get_event_loop()
+        try:
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: " + ctype + b"\r\n"
+                b"Transfer-Encoding: chunked\r\n"
+                + (b"Connection: keep-alive\r\n" if keep else b"Connection: close\r\n")
+                + b"\r\n"
+            )
+            await writer.drain()
+            while True:
+                item = await loop.run_in_executor(None, q.get)
+                if item is _STREAM_DONE:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 and item[0] is _STREAM_ERR:
+                    # mid-stream failure: terminate the chunk stream with an
+                    # in-band error record (headers are already sent)
+                    data = json.dumps({"error": str(item[1])}).encode() + b"\n"
+                    writer.write(hex(len(data))[2:].encode() + b"\r\n" + data + b"\r\n")
+                    break
+                data = _encode_stream_item(item)
+                writer.write(hex(len(data))[2:].encode() + b"\r\n" + data + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        finally:
+            closed.set()
+            # unblock a puller stuck in q.put on a full queue
+            try:
+                while True:
+                    q.get_nowait()
+            except Exception:  # noqa: BLE001 - Empty
+                pass

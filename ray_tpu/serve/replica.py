@@ -84,6 +84,45 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Streaming variant: a generator method, invoked by routers with
+        ``num_returns="streaming"`` so each yielded item is sealed and
+        consumable before the request finishes (reference:
+        serve/_private/proxy.py:542 streaming send_request_to_replica +
+        replica.py:533 handle_request_streaming). Non-generator results
+        stream as a single item."""
+        with self._lock:
+            if self._ongoing >= self._max_ongoing:
+                raise ReplicaOverloadedError(
+                    f"replica {self._replica_id} at max_ongoing_requests="
+                    f"{self._max_ongoing}"
+                )
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method, None)
+                if fn is None:
+                    raise AttributeError(
+                        f"deployment '{self._deployment.name}' has no method '{method}'"
+                    )
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = _run_coro(result)
+            if inspect.isgenerator(result):
+                yield from result
+            elif inspect.isasyncgen(result):
+                from ray_tpu.core.streaming import iter_async_gen
+
+                yield from iter_async_gen(result)
+            else:
+                yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     # ---------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:
         with self._lock:

@@ -103,6 +103,68 @@ class Router:
             return ref, replica
         raise exc.RayTpuError(f"no route for {self._app}.{method}: {last}")
 
+    def route_streaming(self, method: str, args: tuple, kwargs: dict,
+                        max_attempts: int = 10):
+        """Submit a streaming request; returns (ObjectRefGenerator, replica).
+        Items become available as the replica's generator yields."""
+        self._refresh()
+        last: Optional[Exception] = None
+        for _ in range(max_attempts):
+            try:
+                replica = self._pick()
+            except exc.RayTpuError as e:
+                last = e
+                time.sleep(0.2)
+                self._refresh(force=True)
+                continue
+            self._note(replica, +1)
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method, args, kwargs)
+            return gen, replica
+        raise exc.RayTpuError(f"no route for {self._app}.{method}: {last}")
+
+    def call_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Route AND stream VALUES, retrying overload/replica-death on other
+        replicas while no item has been delivered yet (after the first item
+        the stream is already partially consumed; mid-stream failures
+        propagate)."""
+        from ray_tpu.serve.replica import ReplicaOverloadedError
+
+        attempts = 0
+        while True:
+            gen, replica = self.route_streaming(method, args, kwargs)
+            it = iter(gen)
+            try:
+                try:
+                    first_ref = next(it)
+                except StopIteration:
+                    return
+                try:
+                    first = ray_tpu.get(first_ref)
+                except Exception as e:  # noqa: BLE001
+                    retryable = (
+                        isinstance(e, ReplicaOverloadedError)
+                        or "ReplicaOverloadedError" in type(e).__name__
+                        or isinstance(e, (exc.ActorDiedError, exc.ActorUnavailableError))
+                    )
+                    if retryable:
+                        if isinstance(e, (exc.ActorDiedError, exc.ActorUnavailableError)):
+                            self._evict(replica)
+                            self._refresh(force=True)
+                        attempts += 1
+                        if attempts > 20:
+                            raise
+                        time.sleep(min(0.05 * attempts, 0.5))
+                        continue
+                    raise
+                yield first
+                for ref in it:
+                    yield ray_tpu.get(ref)
+                return
+            finally:
+                self._note(replica, -1)
+
     def call(self, method: str, args: tuple, kwargs: dict, timeout: Optional[float] = None):
         """Route AND resolve, retrying overloads on other replicas
         (the synchronous fast path used by the proxy)."""

@@ -1,0 +1,267 @@
+"""Streaming generators: num_returns="streaming" + ObjectRefGenerator.
+
+Reference capability: python/ray/_raylet.pyx:281 (ObjectRefGenerator),
+:1206,1263 (per-item report paths); python/ray/tests/test_streaming_generator.py
+is the model for the scenarios. Done-criteria (VERDICT r2 item 1): a remote
+generator yields 1,000 items consumed incrementally with flat memory.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.core.rpc import SyncRpcClient
+
+
+# --------------------------------------------------------------------- local
+
+
+def test_streaming_basic(ray_tpu_local):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    g = gen.remote(5)
+    assert isinstance(g, ray_tpu.ObjectRefGenerator)
+    vals = [ray_tpu.get(r) for r in g]
+    assert vals == [0, 10, 20, 30, 40]
+    assert g.completed()
+
+
+def test_streaming_empty_and_dynamic_alias(ray_tpu_local):
+    @ray_tpu.remote(num_returns="dynamic")
+    def empty():
+        if False:
+            yield 1
+
+    assert list(empty.remote()) == []
+
+
+def test_streaming_error_mid_stream(ray_tpu_local):
+    @ray_tpu.remote(num_returns="streaming")
+    def boom():
+        yield 1
+        yield 2
+        raise ValueError("mid-stream")
+
+    it = iter(boom.remote())
+    assert ray_tpu.get(next(it)) == 1
+    assert ray_tpu.get(next(it)) == 2
+    with pytest.raises(ValueError, match="mid-stream"):
+        ray_tpu.get(next(it))
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_streaming_not_a_generator(ray_tpu_local):
+    @ray_tpu.remote(num_returns="streaming")
+    def notgen():
+        return 42
+
+    it = iter(notgen.remote())
+    with pytest.raises(Exception, match="generator"):
+        ray_tpu.get(next(it))
+
+
+def test_streaming_backpressure_blocks_producer(ray_tpu_local):
+    produced = []
+
+    @ray_tpu.remote(num_returns="streaming", _generator_backpressure=4)
+    def gen():
+        for i in range(50):
+            produced.append(i)  # local mode: closure shared in-process
+            yield i
+
+    it = iter(gen.remote())
+    first = ray_tpu.get(next(it))
+    assert first == 0
+    time.sleep(0.5)  # give the producer time to run ahead if unbounded
+    # consumer at index 1: producer may be at most backpressure items ahead
+    assert len(produced) <= 1 + 4 + 1, produced
+    rest = [ray_tpu.get(r) for r in it]
+    assert rest == list(range(1, 50))
+    assert len(produced) == 50
+
+
+def test_streaming_early_close_stops_producer(ray_tpu_local):
+    produced = []
+    stopped = threading.Event()
+
+    @ray_tpu.remote(num_returns="streaming", _generator_backpressure=2)
+    def gen():
+        try:
+            for i in range(10_000):
+                produced.append(i)
+                yield i
+        finally:
+            stopped.set()
+
+    g = gen.remote()
+    it = iter(g)
+    ray_tpu.get(next(it))
+    g.close()
+    assert stopped.wait(5.0), "producer did not stop after close()"
+    assert len(produced) < 100
+
+
+def test_streaming_actor_sync(ray_tpu_local):
+    @ray_tpu.remote
+    class Streamer:
+        def tokens(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+        def plain(self):
+            return "ok"
+
+    a = Streamer.remote()
+    toks = [ray_tpu.get(r) for r in a.tokens.options(num_returns="streaming").remote(5)]
+    assert toks == [f"tok{i}" for i in range(5)]
+    # non-streaming calls on the same actor still work
+    assert ray_tpu.get(a.plain.remote()) == "ok"
+
+
+def test_streaming_actor_async(ray_tpu_local):
+    @ray_tpu.remote
+    class AsyncStreamer:
+        async def tokens(self, n):
+            for i in range(n):
+                yield i + 100
+
+    a = AsyncStreamer.remote()
+    vals = [ray_tpu.get(r) for r in a.tokens.options(num_returns="streaming").remote(4)]
+    assert vals == [100, 101, 102, 103]
+
+
+def test_streaming_async_iteration(ray_tpu_local):
+    import asyncio
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    async def consume():
+        out = []
+        async for ref in gen.remote(6):
+            out.append(ray_tpu.get(ref))
+        return out
+
+    assert asyncio.run(consume()) == list(range(6))
+
+
+def test_streaming_refs_usable_out_of_order(ray_tpu_local):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield "a"
+        yield "b"
+        yield "c"
+
+    refs = list(gen.remote())
+    # collected first, resolved later, in any order
+    assert ray_tpu.get(refs[2]) == "c"
+    assert ray_tpu.get(refs[0]) == "a"
+    assert ray_tpu.get(refs[1]) == "b"
+
+
+# -------------------------------------------------------------------- cluster
+
+
+def test_cluster_streaming_preexec_failure_surfaces():
+    """A task that fails BEFORE its generator runs (here: 3 chips is not a
+    valid chip subset on a 4-chip host) must surface the error to the
+    streaming consumer as item 0 + end-of-stream, not hang."""
+    import os
+
+    from ray_tpu.core import accelerators
+
+    os.environ[accelerators.FAKE_CHIPS_ENV] = "4"
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        ray_tpu.init(address=c.gcs_address)
+
+        @ray_tpu.remote(num_returns="streaming", num_tpus=3)  # invalid subset
+        def needs_tpu():
+            yield 1
+
+        it = iter(needs_tpu.remote())
+        with pytest.raises(Exception, match="TPU"):
+            ray_tpu.get(next(it))
+        ray_tpu.shutdown()
+        c.shutdown()
+    finally:
+        del os.environ[accelerators.FAKE_CHIPS_ENV]
+
+
+
+@pytest.fixture(scope="module")
+def stream_cluster():
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 4,
+                                "object_store_memory": 64 * 1024 * 1024})
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_cluster_streaming_1000_items_flat_memory(stream_cluster):
+    """VERDICT done-criterion: 1,000 items consumed incrementally with flat
+    memory — the 64 MB store moves 1000 × 128 KB = 125 MB of stream data only
+    because backpressure + watermark-driven release keep the working set
+    small (consumed items free on a short grace)."""
+    item_bytes = 128 * 1024
+
+    @ray_tpu.remote(num_returns="streaming")
+    def torrent(n):
+        for i in range(n):
+            yield bytes([i % 256]) * item_bytes
+
+    agent = SyncRpcClient(stream_cluster.nodes[0].address)
+    try:
+        n_seen = 0
+        peak_used = 0
+        for i, ref in enumerate(torrent.remote(1000)):
+            data = ray_tpu.get(ref)
+            assert len(data) == item_bytes and data[0] == i % 256
+            del ref, data  # release: holder removed, item freeable
+            n_seen += 1
+            if i % 100 == 0:
+                peak_used = max(peak_used, agent.call("node_info")["store"]["used"])
+        assert n_seen == 1000
+        # flat memory: working set stays a small multiple of the backpressure
+        # window, nowhere near the 250 MB total streamed
+        assert peak_used < 32 * 1024 * 1024, peak_used
+    finally:
+        agent.close()
+
+
+def test_cluster_streaming_error_and_stop(stream_cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def boom():
+        yield 7
+        raise RuntimeError("cluster mid-stream")
+
+    it = iter(boom.remote())
+    assert ray_tpu.get(next(it)) == 7
+    with pytest.raises(Exception, match="cluster mid-stream"):
+        ray_tpu.get(next(it))
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_cluster_streaming_actor(stream_cluster):
+    @ray_tpu.remote
+    class Streamer:
+        def tokens(self, n):
+            for i in range(n):
+                yield {"token": i}
+
+    a = Streamer.remote()
+    out = [ray_tpu.get(r)["token"]
+           for r in a.tokens.options(num_returns="streaming").remote(20)]
+    assert out == list(range(20))
