@@ -184,6 +184,10 @@ _DEFINITIONS = [
     ("rpc_chaos_seed", 0, int, "Seed for RPC chaos injection."),
     # --- observability ---
     ("metrics_export_port", 0, int, "Prometheus text exposition port (0=disabled)."),
+    ("dashboard_port", 0, int,
+     "HTTP observability plane on the head node (0 = ephemeral port, "
+     "-1 = disabled). Address published under GCS KV 'dashboard:address'."),
+    ("dashboard_host", "127.0.0.1", str, "Dashboard bind host."),
     ("event_log_enabled", True, bool, "Write task/actor state events to the session dir."),
     ("log_to_driver", True, bool, "Forward worker stdout/stderr to the driver."),
     # --- tpu / device ---

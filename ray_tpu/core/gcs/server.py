@@ -976,7 +976,7 @@ class GcsServer:
         self._stream_wake(rec)
         return True
 
-    def _reap_streams(self) -> None:
+    async def _reap_streams(self) -> None:
         """Drop stream records that can no longer matter: fully consumed,
         or closed/abandoned and idle past the holder lease."""
         now = time.monotonic()
@@ -996,13 +996,10 @@ class GcsServer:
         for t in doomed:
             rec = self.streams.pop(t)
             # abandoned/finished streams must not pin items forever
-            holder = self._stream_holder(t)
-            for oid in rec["items"].values():
-                holders = self.object_holders.get(oid)
-                if holders and holder in holders:
-                    holders.discard(holder)
-                    if not holders:
-                        self._pending_free[oid] = now
+            if rec["items"]:
+                await self.rpc_remove_object_refs(
+                    list(rec["items"].values()), self._stream_holder(t)
+                )
 
     async def _gc_loop(self) -> None:
         """Free objects whose cluster-wide holder set has been empty for a
@@ -1013,7 +1010,7 @@ class GcsServer:
         while True:
             await asyncio.sleep(min(0.25, config.object_ref_grace_s / 4))
             self._reap_stale_holders()
-            self._reap_streams()
+            await self._reap_streams()
             if not self._pending_free:
                 continue
             now = time.monotonic()

@@ -37,6 +37,16 @@ from ray_tpu.utils.logging import get_logger
 logger = get_logger("node_agent")
 
 
+def _gauge(name: str, desc: str):
+    """Get-or-create a gauge with tag support (idempotent registration)."""
+    from ray_tpu.utils import metrics
+
+    g = metrics.registry.get(name)
+    if g is None:
+        g = metrics.Gauge(name, desc, tag_keys=("resource",))
+    return g
+
+
 class _WorkerHandle:
     def __init__(self, proc: subprocess.Popen, worker_id: str):
         self.proc = proc
@@ -122,6 +132,10 @@ class NodeAgent:
         self._sched_drainer: Optional[asyncio.Task] = None
         # task_id -> lifecycle state (observability; state API reads this)
         self._task_states: Dict[str, str] = {}
+        # task_id -> [(wall_ts, state), ...] transition log (timeline source;
+        # reference capability: core_worker/profile_event.h -> GcsTaskManager
+        # -> `ray timeline` chrome trace)
+        self._task_events: Dict[str, List[Tuple[float, str]]] = {}
         # job_id -> {proc, log, entrypoint, started} (job supervisor)
         self._jobs: Dict[str, Dict[str, Any]] = {}
         # task_id -> when it first became cluster-infeasible (grace window
@@ -133,6 +147,7 @@ class NodeAgent:
         # task_id -> first time its dispatch target was unreachable
         self._unreachable_since: Dict[str, float] = {}
         self._max_workers = max(1, int(ncpus))
+        self.dashboard = None  # DashboardHead on the head node
         self._shutting_down = False
         # committed placement-group bundle reservations living on THIS node:
         # (pg_id, bundle_index) -> {"total": resources, "avail": remaining}.
@@ -156,11 +171,26 @@ class NodeAgent:
         await self.gcs.subscribe("nodes", self._on_node_event)
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         self._supervise_task = asyncio.ensure_future(self._supervise_loop())
+        if self.is_head and config.dashboard_port >= 0:
+            from ray_tpu.dashboard.head import DashboardHead
+
+            self.dashboard = DashboardHead(
+                self, host=config.dashboard_host, port=config.dashboard_port
+            )
+            try:
+                addr = await self.dashboard.start()
+                await self.gcs.call("kv_put", key="dashboard:address",
+                                    value=addr.encode())
+            except Exception:  # noqa: BLE001 - observability must not block boot
+                logger.exception("dashboard failed to start")
+                self.dashboard = None
         logger.info("node agent %s listening on %s", self.hex[:8], self.rpc.address)
         return host, port
 
     async def stop(self) -> None:
         self._shutting_down = True
+        if self.dashboard is not None:
+            await self.dashboard.stop()
         for t in (self._hb_task, self._supervise_task):
             if t:
                 t.cancel()
@@ -998,6 +1028,13 @@ class NodeAgent:
                     self._set_task_state(tid, "failed")
                     return  # error object already stored by executor
                 last_error = result.get("error", "dispatch failed")
+                if spec.get("streaming") and result.get("reason") != "busy":
+                    # the generator may have begun producing: a re-run would
+                    # duplicate side effects and splice items from a second
+                    # execution into a partially-consumed stream — fail it
+                    # (consumer sees an error item at the next index)
+                    attempt = max_retries + 1
+                    continue
                 if result.get("reason") == "busy":
                     # spillback: the task is merely QUEUED (resources/worker
                     # busy on the chosen node) — not a failure; re-place
@@ -1009,6 +1046,11 @@ class NodeAgent:
                     continue
             except (RpcConnectionError, RpcError, TimeoutError) as e:
                 last_error = str(e)
+                if spec.get("streaming") and dispatch_started:
+                    # connection lost mid-execution of a generator: never
+                    # re-run a possibly-partially-consumed stream
+                    attempt = max_retries + 1
+                    continue
                 if isinstance(e, RpcConnectionError) and not dispatch_started:
                     # target unreachable BEFORE the task could start: a pure
                     # PLACEMENT problem (node died or was scaled down; health
@@ -1339,11 +1381,50 @@ class NodeAgent:
     # ------------------------------------------------------------------ info
     def _set_task_state(self, tid: str, state: str) -> None:
         self._task_states[tid] = state
+        self._task_events.setdefault(tid, []).append((time.time(), state))
         while len(self._task_states) > 20000:  # bounded, like _accepted_tasks
             self._task_states.pop(next(iter(self._task_states)))
+        while len(self._task_events) > 20000:
+            self._task_events.pop(next(iter(self._task_events)))
 
     async def rpc_task_states(self) -> Dict[str, str]:
         return dict(self._task_states)
+
+    async def rpc_task_events(self) -> Dict[str, List[Tuple[float, str]]]:
+        """Per-task (wall_ts, state) transition logs for the timeline."""
+        return {t: list(ev) for t, ev in self._task_events.items()}
+
+    async def rpc_metrics_text(self) -> str:
+        """This node's metrics in Prometheus exposition format, labeled with
+        the node id (reference: _private/metrics_agent.py:483 per-node
+        collector -> Prometheus scrape)."""
+        from ray_tpu.utils import metrics
+
+        self._scrape_gauges()
+        return metrics.registry.prometheus_text(
+            extra_labels={"node": self.hex[:16]}
+        )
+
+    def _scrape_gauges(self) -> None:
+        from ray_tpu.utils import metrics
+
+        usage = self.store.usage()
+        _gauge("ray_tpu_object_store_used_bytes",
+               "Shared-memory object store bytes in use").set(usage.get("used", 0))
+        _gauge("ray_tpu_object_store_capacity_bytes",
+               "Shared-memory object store capacity").set(usage.get("capacity", 0))
+        _gauge("ray_tpu_object_store_spilled_bytes",
+               "Bytes spilled to disk").set(usage.get("spilled", 0))
+        _gauge("ray_tpu_node_workers", "Worker processes on this node").set(
+            len(self._workers))
+        _gauge("ray_tpu_node_active_dispatches",
+               "Tasks queued or running on this node").set(self._active_dispatches)
+        for res in ("CPU", "TPU"):
+            if res in self.total_resources:
+                _gauge("ray_tpu_resource_available", "Available resource units",
+                       ).set(self.available.get(res, 0.0), tags={"resource": res})
+                _gauge("ray_tpu_resource_total", "Total resource units",
+                       ).set(self.total_resources.get(res, 0.0), tags={"resource": res})
 
     # ------------------------------------------------------------------- jobs
     # Driver-script job submission (reference capability:

@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.head import DashboardHead
+
+__all__ = ["DashboardHead"]

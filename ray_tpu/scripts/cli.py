@@ -204,6 +204,35 @@ def cmd_list(args) -> None:
     print(json.dumps(rows, indent=2, default=str))
 
 
+def _dashboard_address(args) -> str:
+    _connect(args)
+    from ray_tpu.core.worker import require_worker
+
+    raw = require_worker().runtime.kv_get("dashboard:address")
+    if not raw:
+        sys.exit("no dashboard registered (head started with dashboard_port=-1?)")
+    return raw.decode()
+
+
+def cmd_dashboard(args) -> None:
+    print(_dashboard_address(args))
+
+
+def cmd_timeline(args) -> None:
+    """Dump the cluster's chrome-trace timeline (reference: `ray timeline`,
+    _private/profiling.py:20-40) — open the file in ui.perfetto.dev."""
+    import urllib.request
+
+    addr = _dashboard_address(args)
+    with urllib.request.urlopen(f"{addr}/api/timeline", timeout=30) as resp:
+        data = resp.read()
+    out = args.output or "ray-tpu-timeline.json"
+    with open(out, "wb") as f:
+        f.write(data)
+    n = len(json.loads(data).get("traceEvents", []))
+    print(f"wrote {n} trace events to {out} (load in ui.perfetto.dev)")
+
+
 def _stream_job_logs(client, job_id: str) -> str:
     """Follow a job's log via absolute offsets (a sliding tail would stop
     advancing past the tail window) until it reaches a terminal status.
@@ -280,6 +309,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("what", choices=["nodes", "actors", "objects", "tasks", "jobs", "pgs"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("dashboard", help="print the dashboard HTTP address")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("timeline", help="dump chrome-trace timeline JSON")
+    p.add_argument("--address", default=None)
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("submit", help="submit a driver script as a job")
     p.add_argument("--address", default=None)

@@ -166,38 +166,46 @@ class ProxyActor:
         else:
             arg = None
         call_args = (arg,) if arg is not None else ()
-        if self._app_streams(app):
-            # hand the connection an item queue fed by a puller thread that
-            # drains the router's (synchronous) value stream. The writer owns
-            # a `closed` event: on client disconnect it stops the puller,
-            # which closes the value stream — running the router's and
-            # replica's finally blocks so ongoing-request accounting and the
-            # generator's backpressure producer are released, never leaked.
-            import queue as _queue
-
-            q: "_queue.Queue" = _queue.Queue(maxsize=64)
+        # controller round-trip inside: keep it off the event-loop thread
+        app_streams = await loop.run_in_executor(None, self._app_streams, app)
+        if app_streams:
+            # hand the connection an asyncio item queue fed by a dedicated
+            # puller thread (one per stream — the writer itself never parks a
+            # shared executor thread between tokens). The writer owns a
+            # `closed` event: on client disconnect the puller stops and
+            # closes the value stream — running the router's and replica's
+            # finally blocks so ongoing-request accounting and the producer's
+            # backpressure gate are released, never leaked. A semaphore
+            # bounds unconsumed items so a slow client can't buffer a whole
+            # LLM response in proxy memory.
+            q: "asyncio.Queue" = asyncio.Queue()
+            window = threading.Semaphore(64)
             closed = threading.Event()
+
+            def put(item) -> None:
+                loop.call_soon_threadsafe(q.put_nowait, item)
 
             def pull() -> None:
                 stream = router.call_streaming("__call__", call_args, {})
                 try:
                     for item in stream:
+                        while not window.acquire(timeout=0.5):
+                            if closed.is_set():
+                                return
                         if closed.is_set():
                             return
-                        q.put(item)
-                    if not closed.is_set():
-                        q.put(_STREAM_DONE)
+                        put(item)
+                    put(_STREAM_DONE)
                 except BaseException as e:  # noqa: BLE001
-                    if not closed.is_set():
-                        try:
-                            q.put((_STREAM_ERR, e), timeout=1.0)
-                        except Exception:  # noqa: BLE001
-                            pass
+                    try:
+                        put((_STREAM_ERR, e))
+                    except Exception:  # noqa: BLE001
+                        pass  # proxy loop already gone
                 finally:
                     stream.close()
 
             threading.Thread(target=pull, daemon=True, name="proxy-stream-pull").start()
-            return b"STREAM", (q, closed), b"application/x-ndjson"
+            return b"STREAM", (q, window, closed), b"application/x-ndjson"
         try:
             result = await loop.run_in_executor(
                 None, lambda: router.call("__call__", call_args, {})
@@ -250,8 +258,7 @@ class ProxyActor:
         immediately — tokens reach the client before generation finishes.
         On client disconnect the puller is stopped and its stream closed so
         no thread or replica ongoing-slot leaks."""
-        q, closed = payload
-        loop = asyncio.get_event_loop()
+        q, window, closed = payload
         try:
             writer.write(
                 b"HTTP/1.1 200 OK\r\n"
@@ -262,9 +269,10 @@ class ProxyActor:
             )
             await writer.drain()
             while True:
-                item = await loop.run_in_executor(None, q.get)
+                item = await q.get()
                 if item is _STREAM_DONE:
                     break
+                window.release()
                 if isinstance(item, tuple) and len(item) == 2 and item[0] is _STREAM_ERR:
                     # mid-stream failure: terminate the chunk stream with an
                     # in-band error record (headers are already sent)
@@ -277,10 +285,4 @@ class ProxyActor:
             writer.write(b"0\r\n\r\n")
             await writer.drain()
         finally:
-            closed.set()
-            # unblock a puller stuck in q.put on a full queue
-            try:
-                while True:
-                    q.get_nowait()
-            except Exception:  # noqa: BLE001 - Empty
-                pass
+            closed.set()  # puller sees it within its 0.5s acquire window

@@ -127,8 +127,11 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
-    def prometheus_text(self) -> str:
-        """Render every metric in Prometheus text exposition format."""
+    def prometheus_text(self, extra_labels: Optional[Dict[str, str]] = None) -> str:
+        """Render every metric in Prometheus text exposition format.
+        ``extra_labels`` (e.g. {"node": id}) are injected into every sample so
+        multi-node aggregation keeps per-node series distinct."""
+        base: TagKey = _tags_key(extra_labels)
         lines: List[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
@@ -137,7 +140,7 @@ class MetricsRegistry:
             if isinstance(m, (Counter, Gauge)):
                 lines.append(f"# TYPE {m.name} {m.KIND}")
                 for tags, value in m.samples():
-                    lines.append(f"{m.name}{_fmt_tags(tags)} {value}")
+                    lines.append(f"{m.name}{_fmt_tags(base + tags)} {value}")
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {m.name} histogram")
                 with m._lock:
@@ -146,12 +149,12 @@ class MetricsRegistry:
                         for boundary, c in zip(m.boundaries, counts):
                             cum += c
                             lines.append(
-                                f'{m.name}_bucket{_fmt_tags(tags, ("le", str(boundary)))} {cum}'
+                                f'{m.name}_bucket{_fmt_tags(base + tags, ("le", str(boundary)))} {cum}'
                             )
                         cum += counts[-1]
-                        lines.append(f'{m.name}_bucket{_fmt_tags(tags, ("le", "+Inf"))} {cum}')
-                        lines.append(f"{m.name}_sum{_fmt_tags(tags)} {m._sums[tags]}")
-                        lines.append(f"{m.name}_count{_fmt_tags(tags)} {m._totals[tags]}")
+                        lines.append(f'{m.name}_bucket{_fmt_tags(base + tags, ("le", "+Inf"))} {cum}')
+                        lines.append(f"{m.name}_sum{_fmt_tags(base + tags)} {m._sums[tags]}")
+                        lines.append(f"{m.name}_count{_fmt_tags(base + tags)} {m._totals[tags]}")
         return "\n".join(lines) + "\n"
 
 
