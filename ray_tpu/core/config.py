@@ -172,6 +172,16 @@ _DEFINITIONS = [
      "Control-service health ping period."),
     ("health_check_failure_threshold", 5, int,
      "Missed health checks before a node is declared dead."),
+    # --- memory monitor / OOM protection ---
+    ("memory_monitor_refresh_ms", 250, int,
+     "Host-memory monitor poll interval (0 = disabled). Reference: "
+     "memory_monitor.h:52 kernel polling."),
+    ("memory_usage_threshold", 0.95, float,
+     "Fraction of host memory in use above which the agent kills workers "
+     "to protect the node (reference: worker_killing_policy.h:34)."),
+    ("min_memory_free_bytes", -1, int,
+     "Absolute free-memory floor that also triggers the OOM killer when "
+     "crossed (-1 = derive from memory_usage_threshold only)."),
     # --- rpc ---
     ("rpc_connect_timeout_s", 10.0, float, "Socket connect timeout."),
     ("rpc_call_timeout_s", 60.0, float, "Default RPC deadline."),

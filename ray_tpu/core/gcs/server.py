@@ -100,6 +100,11 @@ class GcsServer:
         # retrying placement every 50ms counts ONCE, not once per retry
         # (reference: resource_demand_scheduler's pending snapshot).
         self._unmet_demand: Dict[str, Tuple[float, Dict[str, float]]] = {}
+        # object hex -> futures resolved on the next location-state change
+        # (registered somewhere, or lost via node death). Backs the
+        # wait_object_located long-poll handlers that replace agent-side
+        # lookup polling (reference: object_directory.h subscription model).
+        self._object_waiters: Dict[str, List[asyncio.Future]] = {}
 
     async def start(self) -> Tuple[str, int]:
         host, port = await self.rpc.start()
@@ -210,9 +215,12 @@ class GcsServer:
         self.available.pop(node_id, None)
         if self._external:
             self._external.remove_node(node_id)
-        # drop object locations on that node
-        for rec in self.objects.values():
-            rec["locations"].discard(node_id)
+        # drop object locations on that node; wake long-poll waiters so they
+        # observe "lost" promptly and can start lineage reconstruction
+        for object_id, rec in self.objects.items():
+            if node_id in rec["locations"]:
+                rec["locations"].discard(node_id)
+                self._wake_object_waiters(object_id)
         # task pins owned by the dead node's agent would never be removed
         self._drop_node_task_pins(node_id)
         # fail over actors
@@ -732,6 +740,7 @@ class GcsServer:
         rec["size"] = size
         rec["locations"].add(node_id)
         rec["had_locations"] = True
+        self._wake_object_waiters(object_id)
         if contained:
             # ObjectRefs serialized INSIDE this object: the container holds
             # them until it is freed, so `return ray.put(x)` style nesting
@@ -774,6 +783,77 @@ class GcsServer:
             # can bring it back — waiting won't (object_recovery_manager.h:41)
             "lost": not rec["locations"] and rec.get("had_locations", False),
         }
+
+    def _wake_object_waiters(self, object_id: str) -> None:
+        for fut in self._object_waiters.pop(object_id, ()):  # one-shot wake
+            if not fut.done():
+                fut.set_result(True)
+
+    async def rpc_wait_object_located(
+        self, object_id: str, timeout_s: float = 10.0
+    ) -> Optional[Dict[str, Any]]:
+        """Long-poll lookup: returns as soon as the object has a location (or
+        is known lost), else after timeout_s with the current record.
+        Replaces agent-side lookup_object polling (event-driven wait;
+        reference: ownership-based object directory subscriptions,
+        object_directory.h:57)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            rec = await self.rpc_lookup_object(object_id)
+            if rec is not None and (rec["locations"] or rec["lost"]):
+                return rec
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return rec
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._object_waiters.setdefault(object_id, []).append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=remaining)
+            except asyncio.TimeoutError:
+                waiters = self._object_waiters.get(object_id)
+                if waiters and fut in waiters:
+                    waiters.remove(fut)
+                    if not waiters:
+                        del self._object_waiters[object_id]
+                return await self.rpc_lookup_object(object_id)
+
+    async def rpc_wait_objects_located(
+        self, object_ids: List[str], num_returns: int, timeout_s: float = 10.0
+    ) -> List[str]:
+        """Long-poll `ray.wait` backend: block until >= num_returns of the
+        ids have a registered location, then return the located subset."""
+        deadline = time.monotonic() + timeout_s
+
+        def located() -> List[str]:
+            out = []
+            for object_id in object_ids:
+                rec = self.objects.get(object_id)
+                if rec is not None and rec["locations"]:
+                    out.append(object_id)
+            return out
+
+        while True:
+            ready = located()
+            if len(ready) >= min(num_returns, len(object_ids)):
+                return ready
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return ready
+            pending = [o for o in object_ids if o not in set(ready)]
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            for object_id in pending:
+                self._object_waiters.setdefault(object_id, []).append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=remaining)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                for object_id in pending:
+                    waiters = self._object_waiters.get(object_id)
+                    if waiters and fut in waiters:
+                        waiters.remove(fut)
+                        if not waiters:
+                            del self._object_waiters[object_id]
 
     async def rpc_free_object_everywhere(self, object_id: str) -> bool:
         """Explicit free: drop all bookkeeping and delete every copy.

@@ -63,6 +63,9 @@ class _WorkerHandle:
         self.tpu_chips: Optional[Tuple[int, ...]] = None  # dedicated chip subset
         self.env_hash: str = ""          # runtime-env pool key
         self.staged_cwd: Optional[str] = None
+        # task currently executing on this worker (OOM kill-policy input)
+        self.running_task: Optional[Dict[str, Any]] = None
+        self.task_started_at: float = 0.0
 
 
 class NodeAgent:
@@ -116,6 +119,15 @@ class NodeAgent:
         # idle task-pool workers, keyed by runtime-env hash ("" = plain):
         # envs never share worker processes (reference: pool env isolation)
         self._idle_workers: Dict[str, List[_WorkerHandle]] = {}
+        # env-hash -> event set whenever a worker of that env becomes IDLE;
+        # _lease_worker blocks on this instead of a fixed-interval poll
+        self._worker_free_events: Dict[str, asyncio.Event] = {}
+        # set whenever execution resources are released (local queue wakeup)
+        self._resources_free_event = asyncio.Event()
+        self._memory_task: Optional[asyncio.Task] = None
+        # task_id -> OOM kill message: lets the dispatch path distinguish an
+        # intentional memory-monitor kill from a plain worker crash
+        self._oom_kills: Dict[str, str] = {}
         self._peer_clients: Dict[str, RpcClient] = {}
         self._peer_addr_cache: Dict[str, str] = {}
         self._hb_task: Optional[asyncio.Task] = None
@@ -171,6 +183,8 @@ class NodeAgent:
         await self.gcs.subscribe("nodes", self._on_node_event)
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         self._supervise_task = asyncio.ensure_future(self._supervise_loop())
+        if config.memory_monitor_refresh_ms > 0:
+            self._memory_task = asyncio.ensure_future(self._memory_monitor_loop())
         if self.is_head and config.dashboard_port >= 0:
             from ray_tpu.dashboard.head import DashboardHead
 
@@ -183,7 +197,12 @@ class NodeAgent:
                                     value=addr.encode())
             except Exception:  # noqa: BLE001 - observability must not block boot
                 logger.exception("dashboard failed to start")
-                self.dashboard = None
+                if self.dashboard is not None:
+                    try:  # kv_put may have failed AFTER the server came up
+                        await self.dashboard.stop()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self.dashboard = None
         logger.info("node agent %s listening on %s", self.hex[:8], self.rpc.address)
         return host, port
 
@@ -191,7 +210,7 @@ class NodeAgent:
         self._shutting_down = True
         if self.dashboard is not None:
             await self.dashboard.stop()
-        for t in (self._hb_task, self._supervise_task):
+        for t in (self._hb_task, self._supervise_task, self._memory_task):
             if t:
                 t.cancel()
         for w in self._workers.values():
@@ -255,6 +274,64 @@ class NodeAgent:
             for w in list(self._workers.values()):
                 if w.state != "DEAD" and w.proc.poll() is not None:
                     await self._on_worker_death(w)
+
+    async def _memory_monitor_loop(self) -> None:
+        """OOM protection (reference: memory_monitor.h:52 + retriable-FIFO
+        kill policy). Above the usage threshold, kill the newest retriable
+        running task's worker; its caller sees a typed OutOfMemoryError (or a
+        retry, if attempts remain). One victim per tick — killing frees
+        memory asynchronously, so re-check before killing again."""
+        from ray_tpu.core.node.memory_monitor import (
+            MemoryMonitor, choose_victim, format_oom_message, process_rss_bytes,
+        )
+
+        monitor = MemoryMonitor(
+            threshold_fraction=config.memory_usage_threshold,
+            min_free_bytes=config.min_memory_free_bytes,
+        )
+        period = config.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            try:
+                report = monitor.check()
+            except OSError:
+                continue  # /proc hiccup: skip the tick
+            if report is None:
+                continue
+            candidates = []
+            for w in self._workers.values():
+                spec = w.running_task
+                if spec is None or w.state == "DEAD" or w.proc.poll() is not None:
+                    continue
+                candidates.append({
+                    "worker": w,
+                    "spec": spec,
+                    # same default as the dispatch retry loop (a spec without
+                    # the key gets 0 retries there, so it is NOT retriable)
+                    "retriable": int(spec.get("max_retries", 0)) > 0,
+                    "started_at": w.task_started_at,
+                })
+            victim = choose_victim(candidates)
+            if victim is None:
+                logger.warning(
+                    "memory pressure (%.1f%% used) but no running task to kill",
+                    report["used_fraction"] * 100)
+                continue
+            w = victim["worker"]
+            spec = victim["spec"]
+            rss = process_rss_bytes(w.proc.pid)
+            msg = format_oom_message(report, spec.get("name", "<task>"), rss)
+            logger.warning("OOM kill: worker %s running %s (rss=%d)",
+                           w.worker_id[:8], spec.get("name"), rss)
+            tid = spec.get("task_id", "")
+            if tid:
+                self._oom_kills[tid] = msg
+                while len(self._oom_kills) > 1000:
+                    self._oom_kills.pop(next(iter(self._oom_kills)))
+            try:
+                w.proc.kill()  # cleanup rides _supervise_loop's death path
+            except Exception:  # noqa: BLE001
+                pass
 
     async def _on_worker_death(self, w: _WorkerHandle) -> None:
         prev_state = w.state
@@ -422,7 +499,10 @@ class NodeAgent:
                     raise TimeoutError(f"TPU worker exited with {w.proc.returncode}")
                 if time.monotonic() > deadline:
                     raise TimeoutError("timed out waiting for TPU worker")
-                await asyncio.sleep(0.02)
+                try:  # woken by rpc_worker_ready; chunked only to re-check liveness
+                    await asyncio.wait_for(w.ready.wait(), timeout=0.2)
+                except asyncio.TimeoutError:
+                    pass
         except TimeoutError:
             self._kill_worker(w)
             self._return_chips(chips)
@@ -457,6 +537,7 @@ class NodeAgent:
         w.ready.set()
         if w.tpu_chips is None:
             self._idle_workers.setdefault(w.env_hash, []).append(w)
+            self._notify_worker_free(w.env_hash)
         else:
             # dedicated TPU worker: park in the chip-keyed pool so a worker
             # whose original lease timed out is reusable/reclaimable instead
@@ -472,7 +553,11 @@ class NodeAgent:
                             renv: Optional[Dict[str, Any]] = None) -> _WorkerHandle:
         deadline = time.monotonic() + (timeout or config.worker_start_timeout_s)
         staged = await self._stage_runtime_env(renv) if renv else None
+        free_ev = self._worker_free_events.setdefault(env_hash, asyncio.Event())
         while True:
+            # clear-before-check: a worker freed after the check sets the
+            # event and the wait below returns immediately (no missed wakeup)
+            free_ev.clear()
             idles = self._idle_workers.get(env_hash, [])
             while idles:
                 w = idles.pop()
@@ -494,7 +579,12 @@ class NodeAgent:
                 if len(pool) < self._max_workers * 2:
                     await self._spawn_worker(renv=renv, env_hash=env_hash,
                                              staged_cwd=staged)
-            await asyncio.sleep(0.02)
+            # event-driven wait for the next freed worker; the 0.25 s cap is
+            # only a safety net for spawn failures (a release wakes us at once)
+            try:
+                await asyncio.wait_for(free_ev.wait(), timeout=0.25)
+            except asyncio.TimeoutError:
+                pass
             if time.monotonic() > deadline:
                 raise TimeoutError("timed out waiting for a worker")
 
@@ -525,10 +615,16 @@ class NodeAgent:
             raise KeyError(f"working_dir package {h} not found in GCS KV")
         return stage_package(payload, h, self.session_dir)
 
+    def _notify_worker_free(self, env_hash: str) -> None:
+        ev = self._worker_free_events.get(env_hash)
+        if ev is not None:
+            ev.set()
+
     def _release_worker(self, w: _WorkerHandle) -> None:
         if w.state == "LEASED" and w.proc.poll() is None:
             w.state = "IDLE"
             self._idle_workers.setdefault(w.env_hash, []).append(w)
+            self._notify_worker_free(w.env_hash)
 
     # ------------------------------------------------------------ object api
     async def rpc_create_object(self, object_id: str, size: int) -> Dict[str, Any]:
@@ -596,10 +692,22 @@ class NodeAgent:
             size = self.store.ensure_local(oid)
             if size is not None and self.store.contains(oid):
                 return {"size": size, "is_error": object_id in self.error_objects}
-            # remote: resolve location via GCS, with wait-for-availability
-            backoff = 0.005
+            # remote: resolve location via GCS long-poll (event-driven — the
+            # GCS wakes us on register/lost instead of us re-polling lookup)
             while True:
-                rec = await self.gcs.call("lookup_object", object_id=object_id)
+                chunk = min(2.0, max(0.05, deadline - time.monotonic()))
+                try:
+                    rec = await self.gcs.call(
+                        "wait_object_located", object_id=object_id,
+                        timeout_s=chunk, timeout=chunk + 5.0,
+                    )
+                except (TimeoutError, RpcError):  # chaos-dropped frame: re-poll
+                    rec = None
+                except (RpcConnectionError, OSError):
+                    # GCS down/restarting: the heartbeat loop reconnects the
+                    # shared client; back off instead of failing the wait
+                    await asyncio.sleep(0.2)
+                    rec = None
                 if rec and rec["locations"]:
                     if self.hex in rec["locations"] and self.store.contains(oid):
                         return {"size": rec["size"], "is_error": object_id in self.error_objects}
@@ -613,6 +721,11 @@ class NodeAgent:
                                 "size": rec["size"],
                                 "is_error": object_id in self.error_objects,
                             }
+                        # pull failed (e.g. the only location just crashed and
+                        # the GCS hasn't reaped it yet): the long-poll returns
+                        # instantly while locations look live, so a failed
+                        # pull must back off or this loop spins at full speed
+                        await asyncio.sleep(0.05)
                 elif rec and rec.get("lost"):
                     # every copy died with its node: waiting is pointless —
                     # re-execute the producing task from lineage (reference:
@@ -623,8 +736,6 @@ class NodeAgent:
                     continue  # lookup again: the re-run registered locations
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"object {object_id[:16]} not available")
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 0.5)
 
     async def rpc_ensure_local_batch(
         self, object_ids: List[str], timeout_s: Optional[float] = None
@@ -764,22 +875,34 @@ class NodeAgent:
         """Wait until >= num_returns of the ids are available SOMEWHERE in the
         cluster (GCS-registered) or locally; returns the ready subset."""
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
-        ready: Set[str] = set()
+        ready: Set[str] = set(
+            o for o in object_ids if self.store.contains(ObjectID.from_hex(o))
+        )
         while True:
-            for object_id in object_ids:
-                if object_id in ready:
-                    continue
-                if self.store.contains(ObjectID.from_hex(object_id)):
-                    ready.add(object_id)
-                    continue
-                rec = await self.gcs.call("lookup_object", object_id=object_id)
-                if rec and rec["locations"]:
-                    ready.add(object_id)
             if len(ready) >= num_returns or len(ready) == len(object_ids):
                 break
-            if deadline is not None and time.monotonic() >= deadline:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
                 break
-            await asyncio.sleep(0.01)
+            # event-driven: one GCS long-poll covers every still-pending id
+            # (sealed objects always register at the GCS, so GCS-located is
+            # the cluster-wide readiness signal)
+            pending = [o for o in object_ids if o not in ready]
+            chunk = 2.0 if remaining is None else min(2.0, max(0.05, remaining))
+            try:
+                located = await self.gcs.call(
+                    "wait_objects_located", object_ids=pending,
+                    num_returns=num_returns - len(ready),
+                    timeout_s=chunk, timeout=chunk + 5.0,
+                )
+            except (TimeoutError, RpcError):  # chaos-dropped frame: re-poll
+                located = []
+            except (RpcConnectionError, OSError):  # GCS down: back off, retry
+                await asyncio.sleep(0.2)
+                located = []
+            ready.update(located)
+            if not located and remaining is not None and remaining <= chunk:
+                break
         return [o for o in object_ids if o in ready]
 
     async def rpc_free_objects(self, object_ids: List[str]) -> bool:
@@ -965,6 +1088,7 @@ class NodeAgent:
         tid = spec.get("task_id", "")
         attempt = 0
         last_error = "unknown"
+        last_error_type = "WorkerCrashedError"
         skip_local = False  # set after a local busy-grant: spill back via GCS
         while attempt <= max_retries:
             target = None
@@ -1028,6 +1152,8 @@ class NodeAgent:
                     self._set_task_state(tid, "failed")
                     return  # error object already stored by executor
                 last_error = result.get("error", "dispatch failed")
+                last_error_type = ("OutOfMemoryError" if result.get("oom")
+                                   else "WorkerCrashedError")
                 if spec.get("streaming") and result.get("reason") != "busy":
                     # the generator may have begun producing: a re-run would
                     # duplicate side effects and splice items from a second
@@ -1069,7 +1195,7 @@ class NodeAgent:
         self._set_task_state(tid, "failed")
         await self._store_error(
             spec, f"Task {spec.get('name')} failed after {max_retries} retries: {last_error}",
-            error_type="WorkerCrashedError",
+            error_type=last_error_type,
         )
 
     async def _check_feasible(self, spec: Dict[str, Any]) -> bool:
@@ -1108,7 +1234,18 @@ class NodeAgent:
         if token is None:
             deadline = time.monotonic() + config.local_queue_wait_s
             while token is None and time.monotonic() < deadline:
-                await asyncio.sleep(0.01)
+                # event-driven: woken by _release_token when resources free up
+                self._resources_free_event.clear()
+                token = self._acquire_for_spec(spec)
+                if token is not None:
+                    break
+                try:
+                    await asyncio.wait_for(
+                        self._resources_free_event.wait(),
+                        timeout=max(0.01, min(0.25, deadline - time.monotonic())),
+                    )
+                except asyncio.TimeoutError:
+                    pass
                 token = self._acquire_for_spec(spec)
         if token is None:
             return {"ok": False, "retryable": True, "reason": "busy", "error": "resources busy"}
@@ -1138,6 +1275,8 @@ class NodeAgent:
             await self._store_error(spec, f"runtime_env setup failed: {e}")
             return {"ok": False, "retryable": False, "error": str(e)}
         w.lease_token = token
+        w.running_task = spec
+        w.task_started_at = time.monotonic()
         try:
             result = await w.client.call("run_task", spec=spec, timeout=None)
             return {"ok": True, **(result or {})}
@@ -1145,8 +1284,15 @@ class NodeAgent:
             if isinstance(e, RpcError):
                 # handler-level failure: error object was stored by the worker
                 return {"ok": False, "retryable": False, "error": str(e)}
+            oom_msg = self._oom_kills.pop(spec.get("task_id", ""), None)
+            if oom_msg is not None:
+                # the memory monitor killed this worker deliberately: typed
+                # failure (or retry) instead of a generic crash
+                return {"ok": False, "retryable": True, "error": oom_msg,
+                        "oom": True}
             return {"ok": False, "retryable": True, "error": f"worker connection lost: {e}"}
         finally:
+            w.running_task = None
             if not w.blocked:
                 self._release_token(token)
             else:
@@ -1230,6 +1376,7 @@ class NodeAgent:
                     rec["avail"][r] = rec["avail"].get(r, 0.0) + v
         else:
             self._release_resources(resources)
+        self._resources_free_event.set()  # wake local-queue waiters
 
     def _reacquire_token(self, token: Tuple[str, Any, Dict[str, float]]) -> None:
         """Forcible re-acquire after a blocked worker resumes: brief
@@ -1352,6 +1499,7 @@ class NodeAgent:
             else:
                 w.state = "IDLE"
                 self._idle_workers.setdefault(w.env_hash, []).append(w)
+                self._notify_worker_free(w.env_hash)
             return {"ok": False, "retryable": False, "error": result.get("error", "")}
         await self.gcs.call(
             "actor_started", actor_id=spec["actor_id"], node_id=self.hex, address=w.address

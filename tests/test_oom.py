@@ -1,0 +1,103 @@
+"""OOM protection: memory monitor + retriable-FIFO kill policy
+(reference: src/ray/common/memory_monitor.h:52,
+src/ray/raylet/worker_killing_policy_retriable_fifo.h)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.core.node.memory_monitor import (
+    MemoryMonitor, choose_victim, read_host_memory,
+)
+from ray_tpu.exceptions import OutOfMemoryError
+
+
+# ------------------------------------------------------------------ unit
+
+def test_monitor_threshold_detection():
+    mem = {"total": 100, "available": 50}
+    m = MemoryMonitor(threshold_fraction=0.8,
+                      read_memory=lambda: (mem["total"], mem["available"]))
+    assert m.check() is None          # 50% used
+    mem["available"] = 10             # 90% used
+    report = m.check()
+    assert report is not None and report["used_fraction"] == pytest.approx(0.9)
+
+
+def test_monitor_free_floor():
+    m = MemoryMonitor(threshold_fraction=1.0, min_free_bytes=30,
+                      read_memory=lambda: (100, 20))
+    assert m.check() is not None      # available < floor
+    m2 = MemoryMonitor(threshold_fraction=1.0, min_free_bytes=10,
+                       read_memory=lambda: (100, 20))
+    assert m2.check() is None
+
+
+def test_choose_victim_retriable_fifo():
+    older_retriable = {"retriable": True, "started_at": 1.0, "id": "a"}
+    newer_retriable = {"retriable": True, "started_at": 2.0, "id": "b"}
+    newest_nonretriable = {"retriable": False, "started_at": 3.0, "id": "c"}
+    v = choose_victim([older_retriable, newer_retriable, newest_nonretriable])
+    assert v["id"] == "b"             # retriable beats non-retriable; newest first
+    v = choose_victim([newest_nonretriable, older_retriable])
+    assert v["id"] == "a"
+    assert choose_victim([]) is None
+    v = choose_victim([{"retriable": False, "started_at": 1.0, "id": "x"},
+                       {"retriable": False, "started_at": 5.0, "id": "y"}])
+    assert v["id"] == "y"             # all non-retriable: still newest first
+
+
+def test_read_host_memory_real_proc():
+    total, available = read_host_memory()
+    assert total > 0 and 0 < available <= total
+
+
+# ------------------------------------------------------------------- e2e
+
+@pytest.fixture(scope="module")
+def oom_cluster():
+    # Floor the monitor a little below the CURRENT free memory: a task that
+    # allocates ~1.5 GiB crosses the floor; everything else stays clear.
+    _, available = read_host_memory()
+    floor = max(256 * 1024**2, available - 700 * 1024**2)
+    os.environ["RAY_TPU_MIN_MEMORY_FREE_BYTES"] = str(floor)
+    os.environ["RAY_TPU_MEMORY_USAGE_THRESHOLD"] = "1.0"  # fraction path off
+    os.environ["RAY_TPU_MEMORY_MONITOR_REFRESH_MS"] = "100"
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        ray_tpu.init(address=c.gcs_address)
+        yield c
+        ray_tpu.shutdown()
+        c.shutdown()
+    finally:
+        for k in ("RAY_TPU_MIN_MEMORY_FREE_BYTES",
+                  "RAY_TPU_MEMORY_USAGE_THRESHOLD",
+                  "RAY_TPU_MEMORY_MONITOR_REFRESH_MS"):
+            os.environ.pop(k, None)
+
+
+def test_oom_task_killed_with_typed_error(oom_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def eat_memory():
+        import numpy as np
+
+        hoard = []
+        for _ in range(64):                     # up to 3.2 GiB, 50 MiB steps
+            hoard.append(np.full(50 * 1024**2, 7, dtype=np.uint8))
+            time.sleep(0.05)
+        return len(hoard)
+
+    ref = eat_memory.remote()
+    with pytest.raises(OutOfMemoryError):
+        ray_tpu.get(ref, timeout=120)
+
+
+def test_node_survives_and_serves_after_oom_kill(oom_cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(20, 22), timeout=60) == 42
