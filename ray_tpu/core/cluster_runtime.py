@@ -99,6 +99,16 @@ class ClusterRuntime(CoreRuntime):
         self._lock = threading.Lock()
         self._bg = concurrent.futures.ThreadPoolExecutor(max_workers=16,
                                                          thread_name_prefix="actor-call")
+        # pipelined task submission: outstanding submit-ack futures. remote()
+        # only blocks when the window is full; get()/wait() barrier on all
+        # acks (the agent pins deps before acking, so the ack is the moment
+        # arg refs may be safely dropped — the barrier preserves that
+        # guarantee at the first point the caller can observe results).
+        from collections import deque
+
+        self._submit_acks: "deque" = deque()
+        self._submit_window = 64
+        self._submit_lock = threading.Lock()  # user threads may race get()/remote()
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
@@ -106,6 +116,13 @@ class ClusterRuntime(CoreRuntime):
         oid = w.next_put_id()
         payload, refs = serialization.pack(value)
         self._queue_ref_op("add", oid.hex())  # this process holds the new ref
+        if len(payload) <= config.max_direct_call_object_size:
+            # small object: one round trip (agent writes the shm segment)
+            self.agent.call(
+                "put_object", object_id=oid.hex(), payload=bytes(payload),
+                contained=[r.id.hex() for r in refs] or None,
+            )
+            return ObjectRef(oid)
         self.agent.call("create_object", object_id=oid.hex(), size=len(payload))
         writer = ShmWriter(oid, len(payload), self.node_hex)
         writer.buffer[:] = payload
@@ -132,6 +149,7 @@ class ClusterRuntime(CoreRuntime):
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         if not refs:
             return []
+        self._barrier_submit_acks()
         blocked = self._notify_blocked(True)
         try:
             # One batched RPC: the agent pulls every object concurrently
@@ -195,12 +213,29 @@ class ClusterRuntime(CoreRuntime):
             return False
 
     def wait(self, refs, num_returns, timeout, fetch_local):
+        self._barrier_submit_acks()
         ids = [r.id.hex() for r in refs]
-        ready_ids = self.agent.call(
-            "wait_objects", object_ids=ids, num_returns=num_returns,
-            timeout=None if timeout is None else timeout + 5.0,  # RPC deadline
-            timeout_s=timeout,
-        )
+        # bounded chunks, like get(): one infinite RPC would hang forever if
+        # its response frame is lost (agent restart, connection blip) — a
+        # re-sent wait is idempotent
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            attempt_s = 10.0 if remaining is None else max(0.0, min(remaining, 10.0))
+            try:
+                ready_ids = self.agent.call(
+                    "wait_objects", object_ids=ids, num_returns=num_returns,
+                    timeout=attempt_s + 10.0, timeout_s=attempt_s,
+                )
+            except TimeoutError:
+                if remaining is not None and remaining <= attempt_s:
+                    ready_ids = []
+                    break
+                continue
+            if len(ready_ids) >= min(num_returns, len(ids)):
+                break
+            if remaining is not None and remaining <= attempt_s:
+                break
         ready_set = set(ready_ids[:num_returns]) if len(ready_ids) > num_returns else set(ready_ids)
         ready = [r for r in refs if r.id.hex() in ready_set]
         not_ready = [r for r in refs if r.id.hex() not in ready_set]
@@ -209,10 +244,19 @@ class ClusterRuntime(CoreRuntime):
     def free(self, refs: Sequence[ObjectRef]) -> None:
         self.agent.call("free_objects", object_ids=[r.id.hex() for r in refs])
 
+    def object_sizes(self, refs: Sequence[ObjectRef]) -> List[Optional[int]]:
+        try:
+            return self.agent.call(
+                "object_sizes", object_ids=[r.id.hex() for r in refs]
+            )
+        except Exception:  # noqa: BLE001 - best-effort (backpressure hint)
+            return [None] * len(refs)
+
     # ------------------------------------------------- streaming generators
     def stream_next(self, task_hex: str, index: int, timeout: Optional[float]):
         """Long-poll the GCS stream directory in bounded chunks (same pattern
         as get(): a dropped frame costs one chunk, not the whole deadline)."""
+        self._barrier_submit_acks()  # a dropped submit must raise, not hang
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
@@ -362,13 +406,44 @@ class ClusterRuntime(CoreRuntime):
         # the agent registers this holder on the returns (and pins deps under
         # a task holder) BEFORE accepting — see agent.rpc_submit_task
         sd["holder"] = self.client_id
-        self.agent.call("submit_task", spec=sd)
+        with self._submit_lock:
+            self._submit_acks.append(self.agent.call_async("submit_task", spec=sd))
+        self._reap_submit_acks()
         if spec.generator:
             # dynamic returns: item holders are registered at stream_put time;
             # materializing refs here would add-then-del the submitter holder
             # on item 0 and free it before the consumer ever sees it
             return []
         return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def _pop_ack(self, only_done: bool) -> Optional[Any]:
+        with self._submit_lock:
+            acks = self._submit_acks
+            if not acks:
+                return None
+            if only_done and not (acks[0].done() or len(acks) > self._submit_window):
+                return None
+            return acks.popleft()
+
+    def _reap_submit_acks(self) -> None:
+        """Harvest completed submit acks; block only when the pipeline
+        window is full (keeps many submits in flight instead of one round
+        trip per .remote() call)."""
+        while True:
+            fut = self._pop_ack(only_done=True)
+            if fut is None:
+                return
+            fut.result()  # surfaces submit failures
+
+    def _barrier_submit_acks(self) -> None:
+        """Wait for every in-flight submit to be accepted (and its deps
+        pinned). Called before get()/wait() so a dropped submit surfaces as
+        an exception instead of a hang."""
+        while True:
+            fut = self._pop_ack(only_done=False)
+            if fut is None:
+                return
+            fut.result()
 
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
         logger.warning("cancel() is not yet supported on the cluster backend")
@@ -589,21 +664,21 @@ class ClusterRuntime(CoreRuntime):
     def create_placement_group(self, bundles, strategy: str, name: str) -> PlacementGroupID:
         w = global_worker()
         pg_id = PlacementGroupID.of(w.job_id)
-        ok = self.gcs.call(
+        # creation always succeeds; an unplaceable group stays PENDING at the
+        # GCS, feeding the autoscaler's demand ledger until capacity arrives
+        # (reference: GcsPlacementGroupManager pending queue)
+        self.gcs.call(
             "create_placement_group",
             pg_id=pg_id.hex(), bundles=bundles, strategy=strategy, name=name,
         )
-        if not ok:
-            raise exc.PlacementGroupError(
-                f"infeasible placement group ({strategy}, bundles={bundles})"
-            )
         return pg_id
 
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
         self.gcs.call("remove_placement_group", pg_id=pg_id.hex())
 
     def placement_group_ready(self, pg_id: PlacementGroupID, timeout) -> bool:
-        return self.gcs.call("placement_group_info", pg_id=pg_id.hex()) is not None
+        info = self.gcs.call("placement_group_info", pg_id=pg_id.hex())
+        return info is not None and info.get("state") == "CREATED"
 
     def placement_group_table(self) -> Dict[str, Dict]:
         return self.gcs.call("placement_group_table")
@@ -620,6 +695,10 @@ class ClusterRuntime(CoreRuntime):
 
     def shutdown(self) -> None:
         self._ref_stop.set()
+        try:
+            self._barrier_submit_acks()
+        except Exception:  # noqa: BLE001
+            pass
         try:
             self.flush_refs()
             self.gcs.call("drop_holder", holder=self.client_id)

@@ -130,9 +130,11 @@ _DEFINITIONS = [
     ("infeasible_task_grace_s", 120.0, float,
      "How long a cluster-infeasible task stays pending (feeding the "
      "autoscaler's demand signal) before erroring."),
-    ("local_queue_wait_s", 0.5, float,
+    ("local_queue_wait_s", 10.0, float,
      "How long a task queues at a busy node before spilling back to global "
-     "placement (the raylet local-queue analogue)."),
+     "placement (the raylet local-queue analogue). Parked tasks cost one "
+     "FIFO entry each; short values make a deep backlog churn through "
+     "re-placement cycles that starve the agent loop."),
     ("scheduler_batch_ms", 5, int,
      "Agent-side coalescing window for GCS placement requests (one batched "
      "schedule RPC per tick instead of a round trip per task)."),
@@ -170,8 +172,10 @@ _DEFINITIONS = [
      "Budget of task-spec lineage kept for object reconstruction."),
     ("health_check_period_ms", 1000, int,
      "Control-service health ping period."),
-    ("health_check_failure_threshold", 5, int,
-     "Missed health checks before a node is declared dead."),
+    ("health_check_failure_threshold", 10, int,
+     "Missed health checks before a node is declared dead (the reference "
+     "defaults to 30 s of missed heartbeats; a busy-but-alive node must not "
+     "be reaped)."),
     # --- memory monitor / OOM protection ---
     ("memory_monitor_refresh_ms", 250, int,
      "Host-memory monitor poll interval (0 = disabled). Reference: "
@@ -205,6 +209,10 @@ _DEFINITIONS = [
     ("ici_bandwidth_gbps", 100.0, float, "Per-link ICI bandwidth estimate for the cost model."),
     ("dcn_bandwidth_gbps", 25.0, float, "Per-host DCN bandwidth estimate for the cost model."),
     ("device_prefetch_depth", 2, int, "Host->HBM double-buffering depth for data loading."),
+    # --- data ---
+    ("data_memory_fraction", 0.25, float,
+     "Fraction of the object-store budget one Data stage may hold in flight "
+     "(byte-budget backpressure; reference: execution/resource_manager.py)."),
 ]
 
 

@@ -32,7 +32,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.config import config
-from ray_tpu.core.rpc import RpcServer
+from ray_tpu.core.rpc import RpcServer, loop_lag_watchdog, spawn
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("gcs")
@@ -105,6 +105,9 @@ class GcsServer:
         # wait_object_located long-poll handlers that replace agent-side
         # lookup polling (reference: object_directory.h subscription model).
         self._object_waiters: Dict[str, List[asyncio.Future]] = {}
+        # recently freed objects: a batched registration that raced the free
+        # must not resurrect a directory record (entries expire in _gc_loop)
+        self._freed_tombstones: Dict[str, float] = {}
 
     async def start(self) -> Tuple[str, int]:
         host, port = await self.rpc.start()
@@ -118,6 +121,7 @@ class GcsServer:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())
         self._gc_task = asyncio.ensure_future(self._gc_loop())
+        self._watchdog_task = spawn(loop_lag_watchdog("gcs"))
         logger.info("GCS listening on %s:%d", host, port)
         return host, port
 
@@ -130,6 +134,8 @@ class GcsServer:
             self._health_task.cancel()
         if self._gc_task:
             self._gc_task.cancel()
+        if getattr(self, "_watchdog_task", None):
+            self._watchdog_task.cancel()
         if self._external:
             await self._external.stop()
         await self.rpc.stop()
@@ -330,8 +336,8 @@ class GcsServer:
                 return None
         if kind == "placement_group":
             pg = self.pgs.get(strat.get("pg", ""))
-            if pg is None:
-                return None
+            if pg is None or pg.get("state") == "PENDING":
+                return None  # pending gang: tasks wait for the reservation
             bundle = strat.get("bundle", -1)
             indices = range(len(pg["bundles"])) if bundle < 0 else [bundle]
             for i in indices:
@@ -372,6 +378,52 @@ class GcsServer:
 
     # ------------------------------------------------------- placement groups
     async def rpc_create_placement_group(
+        self, pg_id: str, bundles: List[Dict[str, float]], strategy: str, name: str
+    ) -> bool:
+        """Register a gang; try to place it now, else leave it PENDING.
+        Pending groups feed the autoscaler's demand ledger and are retried by
+        _pg_retry_loop as capacity arrives (reference: GcsPlacementGroup-
+        Manager pending queue + SchedulePendingPlacementGroups)."""
+        placed = await self._try_place_pg(pg_id, bundles, strategy, name)
+        if not placed:
+            self.pgs[pg_id] = {
+                "bundles": [dict(b) for b in bundles],
+                "strategy": strategy,
+                "name": name,
+                "placement": [],
+                "state": "PENDING",
+            }
+            self._feed_pg_demand(pg_id, bundles)
+        return True
+
+    def _feed_pg_demand(self, pg_id: str, bundles: List[Dict[str, float]]) -> None:
+        now = time.monotonic()
+        for i, b in enumerate(bundles):
+            self._unmet_demand[f"pg:{pg_id}:{i}"] = (now, dict(b))
+
+    async def _retry_pending_pgs(self) -> None:
+        for pg_id, rec in list(self.pgs.items()):
+            if rec.get("state") != "PENDING":
+                continue
+            placed = await self._try_place_pg(
+                pg_id, rec["bundles"], rec["strategy"], rec["name"]
+            )
+            if placed:
+                for i in range(len(rec["bundles"])):
+                    self._unmet_demand.pop(f"pg:{pg_id}:{i}", None)
+            else:
+                self._feed_pg_demand(pg_id, rec["bundles"])
+                since = rec.setdefault("pending_since", time.monotonic())
+                if (not rec.get("warned")
+                        and time.monotonic() - since > config.infeasible_task_grace_s):
+                    rec["warned"] = True
+                    logger.warning(
+                        "placement group %s pending for %.0fs (bundles=%s): "
+                        "no capacity arrived — add nodes or an autoscaler, "
+                        "or remove the group; pg.ready() blocks until placed",
+                        pg_id[:8], time.monotonic() - since, rec["bundles"])
+
+    async def _try_place_pg(
         self, pg_id: str, bundles: List[Dict[str, float]], strategy: str, name: str
     ) -> bool:
         """Two-phase gang reservation (reference: GcsPlacementGroupScheduler
@@ -502,6 +554,8 @@ class GcsServer:
         pg = self.pgs.pop(pg_id, None)
         if pg is None:
             return False
+        for i in range(len(pg.get("bundles", []))):
+            self._unmet_demand.pop(f"pg:{pg_id}:{i}", None)
         for node_id in set(pg["placement"]):
             client = await self._agent_client(node_id)
             if client is not None:
@@ -550,7 +604,7 @@ class GcsServer:
             "creation_spec": spec,
             "death_reason": "",
         }
-        asyncio.ensure_future(self._schedule_actor(actor_id))
+        spawn(self._schedule_actor(actor_id))
         return True
 
     async def _schedule_actor(self, actor_id: str) -> None:
@@ -697,7 +751,7 @@ class GcsServer:
             await self.rpc.publish(
                 "actors", {"event": "restarting", "actor": _actor_public(rec)}
             )
-            asyncio.ensure_future(self._schedule_actor(actor_id))
+            spawn(self._schedule_actor(actor_id))
         else:
             rec.update(state="DEAD", death_reason=reason)
             self._drop_actor_name(actor_id)
@@ -784,6 +838,25 @@ class GcsServer:
             "lost": not rec["locations"] and rec.get("had_locations", False),
         }
 
+    async def rpc_register_objects(self, regs: List[Dict[str, Any]]) -> bool:
+        """Batched object registration: one RPC covers every object an agent
+        sealed in the last coalescing tick (cuts a GCS round trip off every
+        task-return seal; reference: flushed location updates in the
+        ownership protocol)."""
+        for i, r in enumerate(regs):
+            if r["object_id"] in self._freed_tombstones:
+                continue  # freed while the registration was queued: stay dead
+            await self.rpc_register_object(**r)
+            if i % 100 == 99:
+                await asyncio.sleep(0)  # big batch: let heartbeats interleave
+        return True
+
+    async def rpc_pin_tasks(self, pins: List[Dict[str, Any]]) -> bool:
+        """Batched pin_task (one RPC per agent coalescing tick)."""
+        for p in pins:
+            await self.rpc_pin_task(**p)
+        return True
+
     def _wake_object_waiters(self, object_id: str) -> None:
         for fut in self._object_waiters.pop(object_id, ()):  # one-shot wake
             if not fut.done():
@@ -818,17 +891,23 @@ class GcsServer:
                 return await self.rpc_lookup_object(object_id)
 
     async def rpc_wait_objects_located(
-        self, object_ids: List[str], num_returns: int, timeout_s: float = 10.0
+        self, object_ids: List[str], num_returns: int, timeout_s: float = 10.0,
+        include_lost: bool = False,
     ) -> List[str]:
         """Long-poll `ray.wait` backend: block until >= num_returns of the
-        ids have a registered location, then return the located subset."""
+        ids have a registered location, then return the located subset.
+        ``include_lost`` also reports ids whose every copy died (the batched
+        get() path needs the loss signal promptly to start reconstruction)."""
         deadline = time.monotonic() + timeout_s
 
         def located() -> List[str]:
             out = []
             for object_id in object_ids:
                 rec = self.objects.get(object_id)
-                if rec is not None and rec["locations"]:
+                if rec is not None and (rec["locations"] or (
+                    include_lost and not rec["locations"]
+                    and rec.get("had_locations", False)
+                )):
                     out.append(object_id)
             return out
 
@@ -1091,6 +1170,15 @@ class GcsServer:
             await asyncio.sleep(min(0.25, config.object_ref_grace_s / 4))
             self._reap_stale_holders()
             await self._reap_streams()
+            try:
+                await self._retry_pending_pgs()
+            except Exception:  # noqa: BLE001 - retries must not kill the loop
+                logger.exception("pending placement-group retry failed")
+            if self._freed_tombstones:
+                tomb_cutoff = time.monotonic() - 30.0
+                for o in [o for o, t in self._freed_tombstones.items()
+                          if t <= tomb_cutoff]:
+                    del self._freed_tombstones[o]
             if not self._pending_free:
                 continue
             now = time.monotonic()
@@ -1148,6 +1236,7 @@ class GcsServer:
         self.object_holders.pop(object_id, None)
         self._pending_free.pop(object_id, None)
         self.lineage.pop(object_id, None)
+        self._freed_tombstones[object_id] = time.monotonic()
         # the container's grip on its children dies with it (cascade)
         contained = self.object_contains.pop(object_id, [])
         if contained:

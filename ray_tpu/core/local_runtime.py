@@ -447,6 +447,22 @@ class LocalRuntime(CoreRuntime):
         for r in refs:
             self._store.free(r.id)
 
+    def object_sizes(self, refs: Sequence[ObjectRef]) -> List[Optional[int]]:
+        out: List[Optional[int]] = []
+        for r in refs:
+            e = self._store.entry(r.id, create=False)
+            size = None
+            if e is not None and e.future.done():
+                v = e.future.result()
+                size = getattr(v, "nbytes", None)
+                if size is None:
+                    try:
+                        size = len(v)  # bytes-like
+                    except TypeError:
+                        size = None
+            out.append(size)
+        return out
+
     def release(self, oid: ObjectID) -> None:
         # Zero refcount in the only process: drop the value.
         self._store.free(oid)

@@ -30,7 +30,8 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import NodeID, ObjectID
-from ray_tpu.core.rpc import RpcClient, RpcConnectionError, RpcError, RpcServer
+from ray_tpu.core.rpc import (RpcClient, RpcConnectionError, RpcError,
+                              RpcServer, loop_lag_watchdog, spawn)
 from ray_tpu.core.shm_store import ShmObjectStore, ShmReader, ShmWriter
 from ray_tpu.utils.logging import get_logger
 
@@ -122,15 +123,31 @@ class NodeAgent:
         # env-hash -> event set whenever a worker of that env becomes IDLE;
         # _lease_worker blocks on this instead of a fixed-interval poll
         self._worker_free_events: Dict[str, asyncio.Event] = {}
-        # set whenever execution resources are released (local queue wakeup)
-        self._resources_free_event = asyncio.Event()
+        # FIFO of local-queue waiters; each resource release wakes exactly ONE
+        # (a broadcast event here stampedes the loop: hundreds of queued
+        # dispatches all waking per task completion)
+        from collections import deque as _deque
+
+        self._local_wait_q: "_deque[asyncio.Future]" = _deque()
+        self._local_waiters = 0  # LIVE waiters (deque may hold stale futures)
         self._memory_task: Optional[asyncio.Task] = None
         # task_id -> OOM kill message: lets the dispatch path distinguish an
         # intentional memory-monitor kill from a plain worker crash
         self._oom_kills: Dict[str, str] = {}
+        # GCS write batching: submit-time pins and seal-time registrations
+        # coalesce into one RPC per tick each, taking two GCS round trips off
+        # every task's critical path (reference: batched location/ref flushes
+        # in the ownership protocol)
+        self._pin_queue: List[Tuple[Dict[str, Any], asyncio.Future]] = []
+        self._pin_event = asyncio.Event()
+        self._pin_flusher: Optional[asyncio.Task] = None
+        self._reg_queue: List[Dict[str, Any]] = []
+        self._reg_event = asyncio.Event()
+        self._reg_flusher: Optional[asyncio.Task] = None
         self._peer_clients: Dict[str, RpcClient] = {}
         self._peer_addr_cache: Dict[str, str] = {}
         self._hb_task: Optional[asyncio.Task] = None
+        self._hb_client: Optional[RpcClient] = None  # dedicated heartbeat conn
         self._supervise_task: Optional[asyncio.Task] = None
         self._pull_locks: Dict[str, asyncio.Lock] = {}
         self._recon_locks: Dict[str, asyncio.Lock] = {}
@@ -185,6 +202,9 @@ class NodeAgent:
         self._supervise_task = asyncio.ensure_future(self._supervise_loop())
         if config.memory_monitor_refresh_ms > 0:
             self._memory_task = asyncio.ensure_future(self._memory_monitor_loop())
+        self._pin_flusher = asyncio.ensure_future(self._pin_flush_loop())
+        self._reg_flusher = asyncio.ensure_future(self._reg_flush_loop())
+        self._watchdog_task = spawn(loop_lag_watchdog("agent"))
         if self.is_head and config.dashboard_port >= 0:
             from ray_tpu.dashboard.head import DashboardHead
 
@@ -210,9 +230,16 @@ class NodeAgent:
         self._shutting_down = True
         if self.dashboard is not None:
             await self.dashboard.stop()
-        for t in (self._hb_task, self._supervise_task, self._memory_task):
+        for t in (self._hb_task, self._supervise_task, self._memory_task,
+                  self._pin_flusher, self._reg_flusher,
+                  getattr(self, "_watchdog_task", None)):
             if t:
                 t.cancel()
+        if self._hb_client is not None:
+            try:
+                await self._hb_client.close()
+            except Exception:  # noqa: BLE001
+                pass
         for w in self._workers.values():
             try:
                 w.proc.terminate()
@@ -227,16 +254,30 @@ class NodeAgent:
             self._peer_addr_cache.pop(node_id, None)
             client = self._peer_clients.pop(node_id, None)
             if client is not None:
-                asyncio.ensure_future(client.close())
+                spawn(client.close())
 
     async def _heartbeat_loop(self) -> None:
         period = config.health_check_period_ms / 1000.0
+        # Dedicated connection: heartbeats must not queue behind bursty
+        # control traffic (batched pins/registers/long-polls share the main
+        # client's socket and send lock) — a busy node is not a dead node.
         while True:
             await asyncio.sleep(period)
+            # the heartbeat tick doubles as the MAIN client's repairman: no
+            # other path reconnects it after a breakage (long-poll handlers
+            # would otherwise error-loop forever on a closed client)
+            if self.gcs is not None and self.gcs._closed:  # noqa: SLF001
+                try:
+                    await self._reconnect_gcs()
+                except Exception:  # noqa: BLE001
+                    logger.warning("GCS main-client reconnect failed")
             try:
-                ok = await self.gcs.call(
+                if self._hb_client is None or self._hb_client._closed:  # noqa: SLF001
+                    self._hb_client = await RpcClient(self.gcs_address).connect(timeout=2.0)
+                ok = await self._hb_client.call(
                     "heartbeat", node_id=self.hex, available=self.available,
                     load={"dispatching": self._active_dispatches},
+                    timeout=period * config.health_check_failure_threshold,
                 )
                 if not ok:
                     await self.gcs.call(
@@ -399,8 +440,13 @@ class NodeAgent:
                 env.pop(k, None)
             env.update(accelerators.visible_chip_env(list(tpu_chips), self._total_chips))
         else:
-            # CPU workers must not grab the TPU chip
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            # CPU workers must NOT grab the TPU chip: force the cpu backend
+            # (a setdefault is not enough — the inherited env may carry the
+            # TPU platform, and the TPU plugin's sitecustomize can force its
+            # platform past JAX_PLATFORMS when its trigger env is present)
+            if renv is None or "JAX_PLATFORMS" not in (renv.get("env_vars") or {}):
+                env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         logfile = open(os.path.join(self.session_dir, f"worker-{worker_id[:8]}.log"), "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.node.worker_main"],
@@ -597,7 +643,7 @@ class NodeAgent:
                 if w.state == "IDLE" and w.proc.poll() is None:
                     self._kill_worker(w)
                     if w.client_holder:
-                        asyncio.ensure_future(
+                        spawn(
                             self.gcs.call("drop_holder", holder=w.client_holder)
                         )
                     return True
@@ -652,15 +698,134 @@ class NodeAgent:
         self.store.seal(oid)
         if is_error:
             self.error_objects.add(object_id)
-        await self.gcs.call(
-            "register_object", object_id=object_id, size=size, node_id=self.hex,
-            owner=owner, contained=contained or None,
-        )
+        # registration is BATCHED (one GCS RPC covers every seal that arrives
+        # while the previous flush is in flight) but the ack WAITS for the
+        # flush: "sealed" always implies "GCS-registered" (state API and
+        # remote waiters observe the object the moment the seal ack lands)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._reg_queue.append(({
+            "object_id": object_id, "size": size, "node_id": self.hex,
+            "owner": owner, "contained": contained or None,
+        }, fut))
+        self._reg_event.set()
+        await fut
         return True
+
+    async def _reg_flush_loop(self) -> None:
+        # no coalescing sleep: batching happens naturally — seals arriving
+        # during the in-flight GCS RPC pile into the next batch
+        while True:
+            await self._reg_event.wait()
+            self._reg_event.clear()
+            batch, self._reg_queue = self._reg_queue, []
+            if not batch:
+                continue
+            try:
+                await self.gcs.call("register_objects",
+                                    regs=[r for r, _ in batch])
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_result(True)
+            except Exception as e:  # noqa: BLE001 - GCS hiccup: fail seals
+                logger.exception("register_objects flush failed")
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                        fut.exception()  # sealer may have gone: mark seen
+                await asyncio.sleep(0.2)
+
+    async def _pin_flush_loop(self) -> None:
+        while True:
+            await self._pin_event.wait()
+            self._pin_event.clear()
+            batch, self._pin_queue = self._pin_queue, []
+            if not batch:
+                continue
+            try:
+                await self.gcs.call("pin_tasks", pins=[p for p, _ in batch])
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_result(True)
+            except Exception as e:  # noqa: BLE001
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                        fut.exception()  # submitter may have gone: mark seen
+
+    async def rpc_put_object(self, object_id: str, payload: bytes,
+                             owner: str = "", is_error: bool = False,
+                             contained: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Single-round-trip put for small objects: reserve + write + seal +
+        GCS-register in ONE RPC. The payload rides the local socket instead
+        of a client-side shm write, collapsing the create/seal handshake
+        (reference: inlined small returns, max_direct_call_object_size)."""
+        return await self._put_local(object_id, payload, owner=owner,
+                                     is_error=is_error, contained=contained)
+
+    async def _put_local(self, object_id: str, payload: bytes,
+                         owner: str = "", is_error: bool = False,
+                         contained: Optional[List[str]] = None) -> Dict[str, Any]:
+        oid = ObjectID.from_hex(object_id)
+        try:
+            self.store.reserve(oid, len(payload))
+        except FileExistsError:
+            info = self.store.info(oid)
+            if info and info[1]:
+                return {"ok": True, "existing": "sealed"}  # idempotent retry
+            if info and info[0] != len(payload):
+                self.store.abort(oid)
+                self.store.reserve(oid, len(payload))
+        def _write_segment() -> None:
+            # shm create/ftruncate/mmap/copy are synchronous syscalls: run off
+            # the event loop so a put flood can't starve heartbeats/RPCs
+            try:
+                writer = ShmWriter(oid, len(payload), self.hex)
+            except FileExistsError:
+                # stale segment from a crashed writer: attach and overwrite
+                from ray_tpu.core.shm_store import ShmSegment, segment_name
+
+                shm = ShmSegment(segment_name(oid, self.hex), create=False)
+                shm.buf[: len(payload)] = payload
+                shm.close()
+            else:
+                writer.buffer[:] = payload
+                writer.seal()
+
+        if len(payload) > 256 * 1024:
+            # big copy: off the loop (a put flood of large objects would
+            # starve heartbeats); tiny writes are cheaper inline than the
+            # executor handoff
+            await asyncio.get_event_loop().run_in_executor(None, _write_segment)
+        else:
+            _write_segment()
+        await self.rpc_seal_object(object_id, len(payload), owner=owner,
+                                   is_error=is_error, contained=contained)
+        return {"ok": True, "existing": None}
 
     async def rpc_abort_object(self, object_id: str) -> bool:
         self.store.abort(ObjectID.from_hex(object_id))
         return True
+
+    async def rpc_store_debug(self, limit: int = 200) -> List[Dict[str, Any]]:
+        return self.store.debug_entries(limit)
+
+    async def rpc_object_sizes(self, object_ids: List[str]) -> List[Optional[int]]:
+        """Stored sizes (local index first, GCS directory for remote refs);
+        None = unknown. Backpressure hint for the Data executor."""
+        out: List[Optional[int]] = []
+        remote_idx: List[int] = []
+        for object_id in object_ids:
+            info = self.store.info(ObjectID.from_hex(object_id))
+            if info is not None:
+                out.append(info[0])
+            else:
+                out.append(None)
+                remote_idx.append(len(out) - 1)
+        for i in remote_idx:
+            rec = await self.gcs.call("lookup_object", object_id=object_ids[i])
+            if rec is not None:
+                out[i] = rec["size"]
+        return out
 
     async def rpc_object_info(self, object_id: str) -> Optional[Dict[str, Any]]:
         oid = ObjectID.from_hex(object_id)
@@ -740,25 +905,60 @@ class NodeAgent:
     async def rpc_ensure_local_batch(
         self, object_ids: List[str], timeout_s: Optional[float] = None
     ) -> List[Dict[str, Any]]:
-        """Batched ensure_local: all pulls run concurrently on the agent's
-        loop (reference: plasma batched Get + parallel PullManager pulls).
-        Per-object failures come back in-band as {"error", "error_type"} so
-        one missing object doesn't poison the whole batch."""
-        results = await asyncio.gather(
-            *[self.rpc_ensure_local(o, timeout_s=timeout_s) for o in object_ids],
-            return_exceptions=True,
-        )
-        out: List[Dict[str, Any]] = []
-        for object_id, res in zip(object_ids, results):
-            if isinstance(res, BaseException):
-                out.append({
+        """Batched ensure_local (reference: plasma batched Get + parallel
+        PullManager pulls). Ids not yet anywhere wait on ONE shared GCS
+        long-poll for the whole batch — a 1,000-ref get() costs one control
+        RPC per tick, not 1,000 concurrent pollers. Per-object failures come
+        back in-band as {"error", "error_type"} so one missing object doesn't
+        poison the whole batch."""
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None else 1e18)
+        out: Dict[str, Dict[str, Any]] = {}
+
+        async def _finish(object_id: str) -> None:
+            try:
+                out[object_id] = await self.rpc_ensure_local(
+                    object_id, timeout_s=max(0.05, deadline - time.monotonic())
+                )
+            except BaseException as res:  # noqa: BLE001
+                out[object_id] = {
                     "error": str(res) or type(res).__name__,
                     "error_type": type(res).__name__,
                     "object_id": object_id,
-                })
+                }
+
+        # fast path: whatever is already local or already located resolves
+        # through rpc_ensure_local immediately (pulls run concurrently)
+        pending: List[str] = []
+        for object_id in object_ids:
+            if self.store.contains(ObjectID.from_hex(object_id)):
+                await _finish(object_id)
             else:
-                out.append(res)
-        return out
+                pending.append(object_id)
+        while pending:
+            chunk = min(2.0, max(0.05, deadline - time.monotonic()))
+            try:
+                located = await self.gcs.call(
+                    "wait_objects_located", object_ids=pending,
+                    num_returns=len(pending), timeout_s=chunk,
+                    include_lost=True,  # loss must trigger reconstruction NOW
+                    timeout=chunk + 5.0,
+                )
+            except (TimeoutError, RpcError):
+                located = []
+            except (RpcConnectionError, OSError):
+                await asyncio.sleep(0.2)
+                located = []
+            if located:
+                await asyncio.gather(*[_finish(o) for o in located])
+                located_set = set(located)
+                pending = [o for o in pending if o not in located_set]
+            if pending and time.monotonic() >= deadline:
+                for object_id in pending:
+                    # the per-object path reports lost/reconstruction errors;
+                    # anything still unlocated at the deadline times out there
+                    await _finish(object_id)
+                pending = []
+        return [out[o] for o in object_ids]
 
     async def _reconstruct(self, object_id: str) -> None:
         """Re-execute the task that produced a lost object, from GCS lineage.
@@ -939,20 +1139,26 @@ class NodeAgent:
             self._accepted_tasks.popitem(last=False)
         returns: List[str] = spec.get("returns") or []
         deps: List[str] = spec.get("deps") or []
+        pin = {
+            "task_holder": self._task_holder(spec),
+            "deps": deps,
+            "returns": returns,
+            "submitter": spec.get("holder") or "",
+            "spec": spec if (
+                returns and self._lineage_size(spec) <= config.max_lineage_bytes
+            ) else None,
+        }
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pin_queue.append((pin, fut))
+        self._pin_event.set()
         try:
-            await self.gcs.call(
-                "pin_task",
-                task_holder=self._task_holder(spec),
-                deps=deps,
-                returns=returns,
-                submitter=spec.get("holder") or "",
-                spec=spec if (
-                    returns and self._lineage_size(spec) <= config.max_lineage_bytes
-                ) else None,
-            )
+            # the ack still waits for the pin (it closes the submit-then-drop
+            # race) but the pin rides a BATCHED GCS RPC shared with every
+            # other submit in the same tick
+            await fut
         except Exception:  # noqa: BLE001 - pinning is best-effort bookkeeping
             logger.exception("ref pinning failed")
-        asyncio.ensure_future(self._submit_with_retries(spec))
+        spawn(self._submit_with_retries(spec))
         return {"accepted": True}
 
     def _task_holder(self, spec: Dict[str, Any]) -> str:
@@ -1023,7 +1229,7 @@ class NodeAgent:
             fut,
         ))
         if self._sched_drainer is None or self._sched_drainer.done():
-            self._sched_drainer = asyncio.ensure_future(self._drain_sched_queue())
+            self._sched_drainer = spawn(self._drain_sched_queue())
         return await fut
 
     async def _drain_sched_queue(self) -> None:
@@ -1069,7 +1275,7 @@ class NodeAgent:
             # during the last batch's processing, hand off to a fresh drainer
             # rather than strand its future (lost-wakeup)
             if self._sched_queue:
-                self._sched_drainer = asyncio.ensure_future(self._drain_sched_queue())
+                self._sched_drainer = spawn(self._drain_sched_queue())
 
     async def _schedule_batch_individually(
         self, batch: List[Tuple[Dict[str, Any], asyncio.Future]]
@@ -1090,6 +1296,7 @@ class NodeAgent:
         last_error = "unknown"
         last_error_type = "WorkerCrashedError"
         skip_local = False  # set after a local busy-grant: spill back via GCS
+        busy_rounds = 0     # consecutive busy spillbacks (adaptive backoff)
         while attempt <= max_retries:
             target = None
             self._set_task_state(tid, "scheduling")
@@ -1167,9 +1374,13 @@ class NodeAgent:
                     # without consuming a retry attempt (reference: lease
                     # spillback never burns task retries). If the busy grant
                     # was the local fast path, consult the GCS next round.
+                    # Backoff grows with consecutive busy rounds so a deep
+                    # backlog doesn't hammer the scheduler at 50 Hz per task.
                     skip_local = target == self.hex
-                    await asyncio.sleep(0.02)
+                    busy_rounds += 1
+                    await asyncio.sleep(min(0.02 * busy_rounds, 0.25))
                     continue
+                busy_rounds = 0
             except (RpcConnectionError, RpcError, TimeoutError) as e:
                 last_error = str(e)
                 if spec.get("streaming") and dispatch_started:
@@ -1218,37 +1429,64 @@ class NodeAgent:
             self._active_dispatches -= 1
 
     async def _dispatch_local_inner(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        tid = spec.get("task_id", "")
         # 1. dependencies local
         deps: List[str] = spec.get("deps") or []
+        from ray_tpu.exceptions import ObjectStoreFullError
+
         try:
             for dep in deps:
                 await self.rpc_ensure_local(dep, timeout_s=config.worker_lease_timeout_s * 10)
-        except TimeoutError as e:
+        except (TimeoutError, ObjectStoreFullError) as e:
+            # store-full while pulling deps = transient local pressure, not a
+            # task failure: requeue and let GC/spill free space
             return {"ok": False, "retryable": True, "reason": "busy", "error": f"deps unavailable: {e}"}
+        self._set_task_state(tid, "deps-ready")
         # 2. resources (PG tasks draw from their committed bundle). Busy is
         # first absorbed by a short LOCAL wait — tasks queue at the node like
         # the reference raylet's local task queue — and only then reported
         # back for (GCS) spillback, which avoids a control-plane round trip
         # per 10ms of contention.
-        token = self._acquire_for_spec(spec)
+        # NO-STEAL fast path: a fresh dispatch may only grab resources when
+        # nobody is parked in the FIFO — otherwise a sustained arrival stream
+        # starves parked tasks indefinitely (each release stolen by a
+        # newcomer; observed losing a task for 20+ min in the 50k stress)
+        token = self._acquire_for_spec(spec) if self._local_waiters == 0 else None
         if token is None:
             deadline = time.monotonic() + config.local_queue_wait_s
             while token is None and time.monotonic() < deadline:
-                # event-driven: woken by _release_token when resources free up
-                self._resources_free_event.clear()
-                token = self._acquire_for_spec(spec)
-                if token is not None:
-                    break
+                # event-driven FIFO: _release_token wakes exactly one waiter;
+                # the timeout is a safety net for resource-shape mismatches
+                # (e.g. head waiter needs TPU, a CPU was released)
+                fut: asyncio.Future = asyncio.get_event_loop().create_future()
+                self._local_wait_q.append(fut)
+                self._local_waiters += 1
                 try:
+                    # wakeups come from the FIFO (releases chain through
+                    # mismatched waiters); the 0.5 s cap bounds head-of-line
+                    # stalls for resource-SHAPE mismatches — e.g. a CPU task
+                    # parked behind a TPU waiter while CPUs sit free and no
+                    # release ever fires to chain the wakeup
                     await asyncio.wait_for(
-                        self._resources_free_event.wait(),
-                        timeout=max(0.01, min(0.25, deadline - time.monotonic())),
+                        fut,
+                        timeout=max(0.01, min(0.5, deadline - time.monotonic())),
                     )
                 except asyncio.TimeoutError:
-                    pass
+                    fut.cancel()  # abandoned: a release must skip, not consume
+                finally:
+                    self._local_waiters -= 1
                 token = self._acquire_for_spec(spec)
+                if token is None and fut.done() and not fut.cancelled():
+                    # consumed a wakeup without acquiring (wrong resource
+                    # shape): pass it on so the release isn't wasted
+                    while self._local_wait_q:
+                        nxt = self._local_wait_q.popleft()
+                        if not nxt.done():
+                            nxt.set_result(True)
+                            break
         if token is None:
             return {"ok": False, "retryable": True, "reason": "busy", "error": "resources busy"}
+        self._set_task_state(tid, "resources-acquired")
         # 3. worker lease + push. Tasks holding TPU resources run on a
         # DEDICATED worker that sees exactly its assigned chip subset
         # (TPU_VISIBLE_CHIPS); CPU tasks use the shared pool.
@@ -1277,8 +1515,26 @@ class NodeAgent:
         w.lease_token = token
         w.running_task = spec
         w.task_started_at = time.monotonic()
+        self._set_task_state(tid, "running")
         try:
             result = await w.client.call("run_task", spec=spec, timeout=None)
+            self._set_task_state(tid, "executed")
+            # small returns ride inline in the reply: write+seal them here
+            # (one fewer worker->agent round trip per task)
+            inline = (result or {}).pop("inline_returns", None) or []
+            try:
+                for item in inline:
+                    await self._put_local(**item)
+            except ObjectStoreFullError as e:
+                # the task ran but its returns don't fit RIGHT NOW: requeue
+                # (at-least-once; already-sealed returns dedupe on re-store)
+                # instead of surfacing an internal error
+                return {"ok": False, "retryable": True, "reason": "busy",
+                        "error": f"store full for returns: {e}"}
+            if (result or {}).get("state") == "retry_store_full":
+                # worker-side big-return store failed the same way: requeue
+                return {"ok": False, "retryable": True, "reason": "busy",
+                        "error": "store full for returns (worker)"}
             return {"ok": True, **(result or {})}
         except (RpcConnectionError, RpcError) as e:
             if isinstance(e, RpcError):
@@ -1376,7 +1632,11 @@ class NodeAgent:
                     rec["avail"][r] = rec["avail"].get(r, 0.0) + v
         else:
             self._release_resources(resources)
-        self._resources_free_event.set()  # wake local-queue waiters
+        while self._local_wait_q:  # wake ONE live waiter
+            fut = self._local_wait_q.popleft()
+            if not fut.done():
+                fut.set_result(True)
+                break
 
     def _reacquire_token(self, token: Tuple[str, Any, Dict[str, float]]) -> None:
         """Forcible re-acquire after a blocked worker resumes: brief
@@ -1419,7 +1679,7 @@ class NodeAgent:
                 from ray_tpu.core.streaming import stream_item_id
 
                 err_hex = stream_item_id(tid, nxt).hex()
-                self._write_error_object(err_hex, payload)
+                await self._write_error_object(err_hex, payload)
                 await self.gcs.call(
                     "register_object", object_id=err_hex, size=len(payload),
                     node_id=self.hex, owner=":error",
@@ -1432,7 +1692,7 @@ class NodeAgent:
             return
         for object_id in spec.get("returns", []):
             try:
-                self._write_error_object(object_id, payload)
+                await self._write_error_object(object_id, payload)
                 await self.gcs.call(
                     "register_object", object_id=object_id, size=len(payload),
                     node_id=self.hex, owner=":error",
@@ -1440,9 +1700,22 @@ class NodeAgent:
             except FileExistsError:
                 pass  # a retry already stored a result
 
-    def _write_error_object(self, object_id: str, payload: bytes) -> None:
+    async def _write_error_object(self, object_id: str, payload: bytes) -> None:
+        from ray_tpu.exceptions import ObjectStoreFullError
+
         oid = ObjectID.from_hex(object_id)
-        self.store.reserve(oid, len(payload))
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                self.store.reserve(oid, len(payload))
+                break
+            except ObjectStoreFullError:
+                # error objects are what UNBLOCK waiters — losing one turns a
+                # failure into an infinite hang. Wait out transient pressure
+                # (GC/spill frees space within the ref-grace window).
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.1)
         writer = ShmWriter(oid, len(payload), self.hex)
         writer.buffer[:] = payload
         writer.seal()
@@ -1606,7 +1879,7 @@ class NodeAgent:
         self._jobs[job_id] = {"proc": proc, "log": log_path,
                               "entrypoint": entrypoint, "started": time.time()}
         await self._publish_job(job_id, "RUNNING")
-        asyncio.ensure_future(self._watch_job(job_id))
+        spawn(self._watch_job(job_id))
         return job_id
 
     async def _watch_job(self, job_id: str) -> None:

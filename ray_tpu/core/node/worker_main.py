@@ -27,7 +27,7 @@ from ray_tpu.core import serialization
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.rpc import RpcClient, RpcServer, SyncRpcClient
+from ray_tpu.core.rpc import RpcClient, RpcServer, SyncRpcClient, spawn
 from ray_tpu.core.shm_store import ShmReader, ShmWriter
 from ray_tpu.utils.logging import get_logger, setup_component_logging
 
@@ -84,7 +84,7 @@ class WorkerProcess:
             "worker_ready", worker_id=self.worker_id, address=self.rpc.address,
             client_holder=runtime.client_id,
         )
-        asyncio.ensure_future(self._agent_watchdog())
+        spawn(self._agent_watchdog())
         logger.info("worker %s ready at %s", self.worker_id[:8], self.rpc.address)
 
     async def _agent_watchdog(self) -> None:
@@ -129,9 +129,36 @@ class WorkerProcess:
 
         return tuple(resolve(a) for a in args), {k: resolve(v) for k, v in kwargs.items()}
 
-    def _store_value(self, object_id: str, value: Any, is_error: bool = False) -> None:
+    def _store_value(self, object_id: str, value: Any, is_error: bool = False,
+                     collector: Optional[List[Dict[str, Any]]] = None) -> None:
         payload, refs = serialization.pack(value)
         oid = ObjectID.from_hex(object_id)
+        if (collector is not None
+                and len(payload) <= config.max_direct_call_object_size):
+            # small return rides INLINE in the run_task reply: the agent
+            # writes+seals it locally, removing a full worker->agent round
+            # trip per task (reference: max_direct_call_object_size inlining)
+            collector.append({
+                "object_id": object_id, "payload": bytes(payload),
+                "owner": ":error" if is_error else "", "is_error": is_error,
+                "contained": [r.id.hex() for r in refs] or None,
+            })
+            return
+        if len(payload) <= config.max_direct_call_object_size:
+            # small return: one agent round trip (reserve+write+seal+register)
+            resp = asyncio.run_coroutine_threadsafe(
+                self.agent.call(
+                    "put_object", object_id=object_id, payload=bytes(payload),
+                    owner=":error" if is_error else "", is_error=is_error,
+                    contained=[r.id.hex() for r in refs] or None,
+                ),
+                self._loop,
+            ).result()
+            if isinstance(resp, dict) and resp.get("existing") == "sealed":
+                # a previous execution already stored this result; never
+                # rewrite memory that readers may be consuming
+                raise FileExistsError(object_id)
+            return
         fut = asyncio.run_coroutine_threadsafe(
             self.agent.call("create_object", object_id=object_id, size=len(payload)),
             self._loop,
@@ -164,11 +191,12 @@ class WorkerProcess:
             self._loop,
         ).result()
 
-    def _store_returns(self, spec: Dict[str, Any], result: Any) -> None:
+    def _store_returns(self, spec: Dict[str, Any], result: Any,
+                       collector: Optional[List[Dict[str, Any]]] = None) -> None:
         returns: List[str] = spec["returns"]
         if len(returns) == 1:
             try:
-                self._store_value(returns[0], result)
+                self._store_value(returns[0], result, collector=collector)
             except FileExistsError:
                 pass  # duplicate execution (at-least-once): result already stored
             return
@@ -180,23 +208,24 @@ class WorkerProcess:
             )
             for r in returns:
                 try:
-                    self._store_value(r, err, is_error=True)
+                    self._store_value(r, err, is_error=True, collector=collector)
                 except FileExistsError:
                     pass
             return
         for r, v in zip(returns, result):
             try:
-                self._store_value(r, v)
+                self._store_value(r, v, collector=collector)
             except FileExistsError:
                 pass  # duplicate execution (at-least-once): already stored
 
-    def _store_error_returns(self, spec: Dict[str, Any], e: BaseException) -> None:
+    def _store_error_returns(self, spec: Dict[str, Any], e: BaseException,
+                             collector: Optional[List[Dict[str, Any]]] = None) -> None:
         err = exc.TaskError.from_exception(
             e, spec.get("name", "?"), pid=os.getpid(), node_id=self.node_hex
         )
         for r in spec["returns"]:
             try:
-                self._store_value(r, err, is_error=True)
+                self._store_value(r, err, is_error=True, collector=collector)
             except FileExistsError:
                 pass
         if spec.get("streaming") and spec.get("returns"):
@@ -307,14 +336,25 @@ class WorkerProcess:
                 result = fn(*args, **kwargs)
                 if spec.get("streaming"):
                     return self._drive_streaming(spec, result)
-                self._store_returns(spec, result)
-                return {"state": "ok"}
+                inline: List[Dict[str, Any]] = []
+                try:
+                    self._store_returns(spec, result, collector=inline)
+                except Exception as store_err:  # noqa: BLE001
+                    if "ObjectStoreFullError" in repr(store_err):
+                        # the task ran but its returns don't fit the local
+                        # store right now: ask the agent to requeue (GC/spill
+                        # frees space; already-sealed returns dedupe)
+                        return {"state": "retry_store_full",
+                                "inline_returns": inline}
+                    raise
+                return {"state": "ok", "inline_returns": inline}
             except BaseException as e:  # noqa: BLE001
                 attempts += 1
                 if attempts < max_attempts:
                     continue
-                self._store_error_returns(spec, e)
-                return {"state": "error"}
+                inline = []
+                self._store_error_returns(spec, e, collector=inline)
+                return {"state": "error", "inline_returns": inline}
             finally:
                 w.set_task_context(None)
                 # borrows registered during execution must reach the GCS

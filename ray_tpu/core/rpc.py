@@ -76,19 +76,62 @@ class _Chaos:
 # ref-count updates, re-registrations, and the deduplicated task submit. Calls
 # with data-plane side effects that are NOT safely repeatable (run_actor_task
 # mutating actor state, dispatch/run_task long-running executions) stay out.
+async def loop_lag_watchdog(name: str, period: float = 0.5) -> None:
+    """Logs when the event loop stalls (a sleep overshoots badly): stalls
+    starve heartbeats and get healthy nodes marked dead. With
+    RAY_TPU_STALL_DUMP set, arms faulthandler to dump all thread stacks
+    mid-stall (the dump fires only if the loop fails to re-arm in time)."""
+    import faulthandler
+    import os
+    import time
+
+    dump_file = None
+    dump_path = os.environ.get("RAY_TPU_STALL_DUMP")
+    if dump_path:
+        dump_file = open(f"{dump_path}.{name}.{os.getpid()}", "w")  # noqa: SIM115
+    while True:
+        if dump_file is not None:
+            faulthandler.dump_traceback_later(3.0, repeat=False, file=dump_file)
+        t0 = time.monotonic()
+        await asyncio.sleep(period)
+        lag = time.monotonic() - t0 - period
+        if lag > 1.0:
+            logger.warning("%s event loop stalled %.2fs", name, lag)
+
+
+_BACKGROUND_TASKS: set = set()
+
+
+def spawn(coro) -> "asyncio.Task":
+    """ensure_future with a STRONG reference held until completion.
+
+    The event loop only weakly references tasks: a fire-and-forget
+    ``ensure_future`` result that nobody retains can be garbage-collected
+    MID-EXECUTION (observed under a 50k-task load: _submit_with_retries and
+    RPC dispatch tasks vanishing, wedging the scheduler with free resources
+    and losing RPC replies). Every fire-and-forget in this codebase must go
+    through here."""
+    t = asyncio.ensure_future(coro)
+    _BACKGROUND_TASKS.add(t)
+    t.add_done_callback(_BACKGROUND_TASKS.discard)
+    return t
+
+
 RETRY_SAFE_METHODS = frozenset({
     "ping", "get_nodes", "heartbeat", "register_node", "cluster_resources",
     "available_resources", "node_info", "debug_state",
     "next_job_id",  # retry burns an id from the sequence — gaps are fine
     "kv_put", "kv_get", "kv_del", "kv_keys",
-    "schedule", "lookup_object", "register_object", "remove_object_location",
-    "object_info", "read_chunk", "free_object_everywhere", "delete_local_object",
+    "schedule", "lookup_object", "register_object", "register_objects",
+    "pin_tasks", "remove_object_location",
+    "object_info", "object_sizes", "read_chunk", "free_object_everywhere",
+    "delete_local_object",
     "add_object_refs", "remove_object_refs", "pin_task", "drop_holder",
     "holder_heartbeat", "object_ref_counts", "put_lineage", "get_lineage",
     "get_actor", "get_actor_spec", "get_named_actor", "list_named_actors",
     "list_actors", "actor_started", "placement_group_info",
     "placement_group_table", "reserve_bundle", "return_bundle",
-    "create_object", "seal_object", "abort_object", "store_error",
+    "create_object", "seal_object", "abort_object", "store_error", "put_object",
     "stream_put", "stream_end", "stream_next", "stream_wait", "stream_close",
     "stream_state",
     "submit_task", "worker_ready", "worker_blocked", "worker_unblocked",
@@ -165,7 +208,7 @@ class RpcServer:
         try:
             while True:
                 msg = await _read_frame(reader)
-                asyncio.ensure_future(self._dispatch(msg, writer))
+                spawn(self._dispatch(msg, writer))
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         except Exception:
@@ -441,6 +484,17 @@ class SyncRpcClient:
 
     def call(self, method: str, timeout: Any = DEFAULT_TIMEOUT, **params) -> Any:
         return self._run(self._client.call(method, timeout=timeout, **params))
+
+    def call_async(self, method: str, timeout: Any = DEFAULT_TIMEOUT, **params):
+        """Pipelined call: returns a concurrent.futures.Future immediately.
+        Lets a caller keep many requests in flight instead of paying one
+        round trip per call (reference: the core worker submits task leases
+        asynchronously and only the grpc completion queue waits)."""
+        if self._stopped or not self._thread.is_alive():
+            raise RpcConnectionError("client closed")
+        return asyncio.run_coroutine_threadsafe(
+            self._client.call(method, timeout=timeout, **params), self._loop
+        )
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
         self._run(self._client.subscribe(channel, callback))

@@ -45,6 +45,11 @@ class CoreRuntime(abc.ABC):
     @abc.abstractmethod
     def free(self, refs: Sequence[ObjectRef]) -> None: ...
 
+    def object_sizes(self, refs: Sequence[ObjectRef]) -> List[Optional[int]]:
+        """Best-effort stored size per ref (None = unknown). Used by the Data
+        executor's byte-budget backpressure; not part of the public API."""
+        return [None] * len(refs)
+
     def release(self, oid: ObjectID) -> None:
         """Refcount reached zero in this process."""
 

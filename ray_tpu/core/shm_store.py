@@ -17,13 +17,13 @@ spill-to-disk. Differences by design:
 
 from __future__ import annotations
 
+import mmap
 import os
 import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from multiprocessing import shared_memory
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ObjectID
@@ -32,6 +32,8 @@ from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("shm_store")
 
+_SHM_DIR = "/dev/shm"
+
 
 def segment_name(oid: ObjectID, node_suffix: str) -> str:
     # FULL 48-hex id: a truncated prefix would collide for every put of the
@@ -39,18 +41,47 @@ def segment_name(oid: ObjectID, node_suffix: str) -> str:
     return f"rtpu-{node_suffix[:8]}-{oid.hex()}"
 
 
-def _untrack(shm: shared_memory.SharedMemory) -> None:
-    """Detach the segment from this process's multiprocessing
-    resource_tracker. Python registers EVERY SharedMemory (even attaches)
-    and unlinks them when the registering process exits (bpo-38119) — which
-    would destroy sealed objects when a worker exits. Lifetime is owned by
-    the node agent's explicit delete/cleanup instead."""
-    try:
-        from multiprocessing import resource_tracker
+class ShmSegment:
+    """POSIX shm segment via direct /dev/shm open+mmap.
 
-        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
-    except Exception:
-        pass
+    Deliberately NOT multiprocessing.shared_memory: that class registers
+    every segment with the resource_tracker daemon over a pipe, and under
+    load the tracker process starves, its pipe fills, and the register()
+    write BLOCKS the caller — observed freezing the node agent's event loop
+    for 12+ s (heartbeats missed, node declared dead). It also unlinks
+    segments when the registering process exits (bpo-38119), fighting the
+    store's explicit ownership. Segment lifetime here is owned by the node
+    agent's delete/cleanup."""
+
+    __slots__ = ("name", "size", "_mm", "buf")
+
+    def __init__(self, name: str, create: bool, size: int = 0):
+        path = os.path.join(_SHM_DIR, name)
+        flags = os.O_RDWR | ((os.O_CREAT | os.O_EXCL) if create else 0)
+        fd = os.open(path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, max(size, 1))
+            else:
+                size = os.fstat(fd).st_size
+            self._mm = mmap.mmap(fd, max(size, 1))
+        finally:
+            os.close(fd)
+        self.name = name
+        self.size = size
+        self.buf: memoryview = memoryview(self._mm)
+
+    def close(self) -> None:
+        try:
+            self.buf.release()
+            self._mm.close()
+        except (BufferError, ValueError):
+            # numpy views may still alias the map; the OS reclaims at exit
+            pass
+
+    @staticmethod
+    def unlink(name: str) -> None:
+        os.unlink(os.path.join(_SHM_DIR, name))
 
 
 class ShmWriter:
@@ -61,12 +92,11 @@ class ShmWriter:
         self.size = size
         name = segment_name(oid, node_suffix)
         try:
-            self._shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+            self._shm = ShmSegment(name, create=True, size=size)
         except FileExistsError:
             # a retried create (dropped RPC response) already made the
             # segment; attach and (re)write the identical bytes
-            self._shm = shared_memory.SharedMemory(name=name, create=False)
-        _untrack(self._shm)
+            self._shm = ShmSegment(name, create=False)
 
     @property
     def buffer(self) -> memoryview:
@@ -80,8 +110,7 @@ class ShmReader:
     def __init__(self, oid: ObjectID, size: int, node_suffix: str):
         self.oid = oid
         self.size = size
-        self._shm = shared_memory.SharedMemory(name=segment_name(oid, node_suffix), create=False)
-        _untrack(self._shm)
+        self._shm = ShmSegment(segment_name(oid, node_suffix), create=False)
 
     @property
     def buffer(self) -> memoryview:
@@ -207,6 +236,19 @@ class ShmObjectStore:
                 "objects": len(self._entries),
             }
 
+    def debug_entries(self, limit: int = 200) -> List[Dict[str, Any]]:
+        """Per-entry state for debugging store pressure."""
+        with self._lock:
+            out = []
+            for oid, e in self._entries.items():
+                out.append({
+                    "id": oid.hex()[:16], "size": e.size, "sealed": e.sealed,
+                    "pinned": e.pinned, "spilled": e.spilled_path is not None,
+                })
+                if len(out) >= limit:
+                    break
+            return out
+
     # ---- internal ---------------------------------------------------------
     def _ensure_capacity(self, size: int) -> None:
         """Must hold lock. Evict (spill) LRU unpinned sealed objects."""
@@ -303,9 +345,7 @@ class ShmObjectStore:
 
     def _unlink(self, oid: ObjectID) -> None:
         try:
-            shm = shared_memory.SharedMemory(name=segment_name(oid, self.node_suffix))
-            shm.close()
-            shm.unlink()
+            ShmSegment.unlink(segment_name(oid, self.node_suffix))
         except FileNotFoundError:
             pass
         except Exception:  # noqa: BLE001

@@ -4,7 +4,12 @@ import os
 # multi-chip logic runs on host devices). jax may already be PRELOADED by the
 # environment (sitecustomize), so env vars alone are not reliable — use
 # jax.config, which works any time before backend initialization.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# HARD-set (not setdefault): the environment's own sitecustomize exports
+# JAX_PLATFORMS for the real TPU tunnel, and spawned cluster agents/workers
+# inherit os.environ — a setdefault here would leave every subprocess on the
+# real chip instead of the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

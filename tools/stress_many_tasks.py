@@ -1,0 +1,106 @@
+"""Many-task stress: N trivial tasks across a multi-node (multi-process,
+single-box) cluster — the control-plane scale probe the reference exercises
+with many_tasks in its scalability envelopes (reference:
+release/benchmarks/distributed/test_many_tasks.py).
+
+Usage:
+    python tools/stress_many_tasks.py [--tasks 50000] [--nodes 8]
+
+Prints one JSON line with tasks/s and end-to-end wall time.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tasks", type=int, default=50000)
+    parser.add_argument("--nodes", type=int, default=8)
+    parser.add_argument("--cpus-per-node", type=int, default=1)
+    args = parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu.cluster import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": args.cpus_per_node})
+    for _ in range(args.nodes - 1):
+        cluster.add_node(num_cpus=args.cpus_per_node)
+    ray_tpu.init(address=cluster.gcs_address)
+
+    @ray_tpu.remote
+    def nop() -> int:
+        return 0
+
+    # warmup: spin up every node's worker pool
+    ray_tpu.get([nop.remote() for _ in range(args.nodes * 4)], timeout=300)
+
+    def dump_state() -> None:
+        """On a stall: per-node task-state histogram (self-diagnosis)."""
+        from collections import Counter
+
+        from ray_tpu.core.rpc import SyncRpcClient
+
+        try:
+            gcs = SyncRpcClient(cluster.gcs_address)
+            for n in gcs.call("get_nodes"):
+                if not n["Alive"]:
+                    continue
+                agent = SyncRpcClient(n["NodeManagerAddress"])
+                hist = Counter(s.split(":")[0] for s in
+                               agent.call("task_states").values())
+                info = agent.call("node_info")
+                print(f"node {n['NodeID'][:8]}: states={dict(hist)} "
+                      f"avail={info['available']} workers={info['workers']}",
+                      flush=True)
+                agent.close()
+            gcs.close()
+        except Exception as e:  # noqa: BLE001
+            print("state dump failed:", e, flush=True)
+
+    t0 = time.perf_counter()
+    # submit/consume interleaved in windows: bounds driver memory AND keeps
+    # the backlog at one window (a realistic pipeline, not a 50k flood)
+    window = 2000
+    submit_s = 0.0
+    done = 0
+    pending: list = []
+    for i in range(args.tasks):
+        pending.append(nop.remote())
+        if len(pending) >= window:
+            try:
+                got = ray_tpu.get(pending, timeout=600)
+            except Exception:
+                dump_state()
+                raise
+            assert got == [0] * len(got)
+            done += len(got)
+            print(f"  {done}/{args.tasks} "
+                  f"({done / (time.perf_counter() - t0):.0f}/s)", flush=True)
+            pending = []
+    if pending:
+        got = ray_tpu.get(pending, timeout=600)
+        done += len(got)
+    total_s = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "many_tasks",
+        "value": round(args.tasks / total_s, 1),
+        "unit": "tasks/s",
+        "tasks": args.tasks,
+        "nodes": args.nodes,
+        "submit_s": round(submit_s, 2),
+        "total_s": round(total_s, 2),
+    }))
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()
