@@ -1,5 +1,19 @@
-from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    SliceAutoscaler,
+    SliceAutoscalerConfig,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.instance_manager import (
+    CloudProvider,
+    FakeCloudProvider,
+    Instance,
+    InstanceManager,
+)
 from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
 
-__all__ = ["AutoscalerConfig", "StandardAutoscaler", "NodeProvider",
-           "LocalNodeProvider"]
+__all__ = [
+    "AutoscalerConfig", "StandardAutoscaler", "NodeProvider",
+    "LocalNodeProvider", "SliceAutoscaler", "SliceAutoscalerConfig",
+    "CloudProvider", "FakeCloudProvider", "Instance", "InstanceManager",
+]

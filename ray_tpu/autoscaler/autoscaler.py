@@ -162,3 +162,119 @@ class StandardAutoscaler:
             logger.info("scaled down: terminated %s / node %s (idle > %.0fs)",
                         handle, node_id[:8], self.config.idle_timeout_s)
             break  # at most one per tick (conservative)
+
+
+@dataclasses.dataclass
+class SliceAutoscalerConfig:
+    """Slice-gang autoscaling: capacity is added/removed in whole SLICES
+    (reference: v2 instance manager node groups; TPU queued resources)."""
+
+    max_groups: int = 2
+    # one group = `hosts` machines that join as one slice
+    group_config: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {
+            "hosts": 4, "num_cpus": 1, "num_tpus": 4, "slice_label": "v5e-16",
+        })
+    idle_timeout_s: float = 30.0
+    update_interval_s: float = 0.5
+
+
+class SliceAutoscaler:
+    """Demand-driven SLICE scaling over an InstanceManager: unmet TPU demand
+    requests whole slice groups (hosts provision atomically via the cloud
+    provider); a fully-idle group past the timeout drains every host first,
+    then terminates as a unit."""
+
+    def __init__(self, gcs_address: str, manager, config: Optional[SliceAutoscalerConfig] = None):
+        from ray_tpu.autoscaler.instance_manager import RUNNING
+
+        self._RUNNING = RUNNING
+        self.gcs = SyncRpcClient(gcs_address)
+        self.manager = manager
+        self.config = config or SliceAutoscalerConfig()
+        self._group_idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.groups_launched = 0
+        self.groups_terminated = 0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="slice-autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.gcs.close()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.update_interval_s):
+            try:
+                self.update()
+            except Exception:  # noqa: BLE001
+                logger.exception("slice autoscaler update failed")
+
+    def update(self) -> None:
+        self.manager.poll()
+        state = self.gcs.call("autoscaler_state", window_s=10.0)
+        self._maybe_scale_up(state)
+        self._maybe_scale_down(state)
+
+    def _chips_per_group(self) -> float:
+        cfg = self.config.group_config
+        return float(cfg.get("hosts", 1)) * float(cfg.get("num_tpus", 0) or 0)
+
+    def _maybe_scale_up(self, state: Dict[str, Any]) -> None:
+        unmet_chips = sum(s.get("TPU", 0.0) for s in state["unmet_shapes"])
+        if unmet_chips <= 0:
+            return
+        per_group = self._chips_per_group()
+        if per_group <= 0:
+            return
+        needed = -(-int(unmet_chips) // int(per_group))  # ceil
+        active = len(self.manager.active_groups())
+        to_launch = min(needed, self.config.max_groups - active)
+        for _ in range(max(0, to_launch)):
+            self.manager.request_group(self.config.group_config)
+            self.groups_launched += 1
+            logger.info("slice scale-up: requested group (%d active)",
+                        len(self.manager.active_groups()))
+
+    def _maybe_scale_down(self, state: Dict[str, Any]) -> None:
+        if state["unmet_shapes"]:
+            self._group_idle_since.clear()
+            return
+        nodes = state["nodes"]
+        by_address = {info["address"]: (nid, info) for nid, info in nodes.items()}
+        now = time.monotonic()
+        node_ids_by_address = {a: nid for a, (nid, _) in by_address.items()}
+        idle_groups = []
+        for group_id, members in self.manager.active_groups().items():
+            running = [i for i in members if i.state == self._RUNNING]
+            if len(running) < len(members) or not members:
+                continue  # still provisioning: not a scale-down candidate
+            def _idle(inst) -> bool:
+                rec = by_address.get(inst.address)
+                if rec is None:
+                    return False
+                _, info = rec
+                return (info["alive"] and not info.get("load", {}).get("dispatching")
+                        and all(abs(info["available"].get(k, 0.0) - v) < 1e-9
+                                for k, v in info["total"].items()))
+            if all(_idle(i) for i in running):
+                idle_groups.append(group_id)
+        for g in list(self._group_idle_since):
+            if g not in idle_groups:
+                del self._group_idle_since[g]
+        for g in idle_groups:
+            self._group_idle_since.setdefault(g, now)
+        expired = [g for g, t in self._group_idle_since.items()
+                   if now - t > self.config.idle_timeout_s]
+        if expired:
+            g = expired[0]  # one group per tick (conservative)
+            self.manager.drain_and_terminate_group(g, node_ids_by_address)
+            self.groups_terminated += 1
+            self._group_idle_since.pop(g, None)
+            logger.info("slice scale-down: terminated group %s", g)

@@ -148,6 +148,13 @@ class ActorClass:
     def options(self, **new_options) -> "ActorClass":
         return ActorClass(self._cls, {**self._options, **new_options})
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG construction (reference: dag/class_node.py — bind builds
+        a ClassNode; method .bind()s on it chain ClassMethodNodes)."""
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> ActorHandle:
         worker = require_worker()
         opts = self._options

@@ -143,6 +143,13 @@ class RemoteFunction:
         merged = {**self._options, **new_options}
         return RemoteFunction(self._function, merged)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG construction (reference: dag/function_node.py — bind
+        builds a FunctionNode; nothing executes until dag.execute())."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef], "Any"]:
         worker = require_worker()
         opts = self._options

@@ -21,11 +21,14 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.data.block import Batch, Block, BlockAccessor, block_from_batch, block_from_rows, concat_blocks
 from ray_tpu.data.executor import (
     DEFAULT_MAX_IN_FLIGHT,
+    AggregateStage,
     MapStage,
     RepartitionStage,
     ShuffleStage,
+    SortStage,
     Stage,
     StreamingExecutor,
+    ZipStage,
 )
 from ray_tpu.utils.logging import get_logger
 
@@ -105,6 +108,58 @@ class Dataset:
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         return self._with_stage(ShuffleStage(seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed range-partition sort by a column (reference:
+        dataset.py Dataset.sort -> planner/exchange/sort_task_spec.py)."""
+        return self._with_stage(SortStage(key, descending))
+
+    def groupby(self, key: Union[str, List[str]]) -> "GroupedData":
+        """Group rows by key column(s) (reference: Dataset.groupby ->
+        grouped_data.py). Aggregations run as a hash exchange with map-side
+        combine."""
+        keys = [key] if isinstance(key, str) else list(key)
+        return GroupedData(self, keys)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Global aggregation; returns {agg_name: value} (reference:
+        Dataset.aggregate)."""
+        out = self._with_stage(AggregateStage([], list(aggs))).take_all()
+        return out[0] if out else {}
+
+    def sum(self, on: str):
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(on)).get(f"sum({on})")
+
+    def min(self, on: str):
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(on)).get(f"min({on})")
+
+    def max(self, on: str):
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(on)).get(f"max({on})")
+
+    def mean(self, on: str):
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(on)).get(f"mean({on})")
+
+    def std(self, on: str, ddof: int = 1):
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(on, ddof)).get(f"std({on})")
+
+    def unique(self, column: str) -> List[Any]:
+        rows = self.groupby(column).count().take_all()
+        return sorted(r[column] for r in rows)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two datasets with equal row counts (reference:
+        Dataset.zip; right-side column-name collisions get a _1 suffix)."""
+        return self._with_stage(ZipStage(lambda: other._execute()))
 
     def union(self, *others: "Dataset") -> "Dataset":
         selves = [self, *others]
@@ -288,6 +343,78 @@ class Dataset:
 
     def __repr__(self) -> str:
         return f"Dataset(num_stages={len(self._stages)})"
+
+
+class GroupedData:
+    """Result of Dataset.groupby (reference: data/grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, keys: List[str]):
+        self._ds = ds
+        self._keys = keys
+
+    def aggregate(self, *aggs) -> Dataset:
+        return self._ds._with_stage(AggregateStage(self._keys, list(aggs)))
+
+    def count(self) -> Dataset:
+        from ray_tpu.data.aggregate import Count
+
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str, ddof: int = 1) -> Dataset:
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(on, ddof))
+
+    def map_groups(self, fn: Callable[[Dict[str, np.ndarray]], Any]) -> Dataset:
+        """Apply fn to each whole group (rows of one key, as a numpy batch);
+        fn returns a batch/dict of rows (reference: GroupedData.map_groups).
+        Implemented as sort-by-key then per-block group apply — the sort
+        exchange guarantees one group never spans two blocks."""
+        keys = self._keys
+        sorted_ds = self._ds.sort(keys[0])
+
+        def block_fn(block: Block) -> Block:
+            import numpy as np
+
+            from ray_tpu.data.block import BlockAccessor, block_from_batch, concat_blocks
+
+            if block.num_rows == 0:
+                return block
+            acc = BlockAccessor(block)
+            batch = acc.to_numpy()
+            kcol = batch[keys[0]]
+            # group boundaries within the sorted block
+            change = np.nonzero(kcol[1:] != kcol[:-1])[0] + 1
+            starts = np.concatenate([[0], change])
+            ends = np.concatenate([change, [len(kcol)]])
+            outs = []
+            for s, e in zip(starts, ends):
+                sub = {k: v[s:e] for k, v in batch.items()}
+                res = fn(sub)
+                outs.append(block_from_batch(res))
+            return concat_blocks(outs)
+
+        return sorted_ds._with_stage(MapStage("map_groups", block_fn))
 
 
 @ray_tpu.remote

@@ -29,29 +29,57 @@ logger = get_logger("data.executor")
 DEFAULT_MAX_IN_FLIGHT = 4
 
 
+def _memory_budget_bytes() -> int:
+    from ray_tpu.core.config import config
+
+    return int(config.object_store_memory_bytes * config.data_memory_fraction)
+
+
 def _iter_completed(submit_iter: Iterator[ObjectRef], max_in_flight: int,
-                    preserve_order: bool = True) -> Iterator[ObjectRef]:
+                    preserve_order: bool = True,
+                    budget_bytes: Optional[int] = None) -> Iterator[ObjectRef]:
     """Pipelines task submission: keeps up to max_in_flight outstanding,
-    yields refs once complete (in submission order when preserve_order)."""
+    yields refs once complete (in submission order when preserve_order).
+
+    ``budget_bytes`` adds byte-budget backpressure (reference:
+    execution/resource_manager.py + streaming_executor_state.py:527 budget-
+    aware op selection): the submit iterator may yield ``(ref, size_hint)``
+    tuples (size of the task's INPUT block — a good output proxy); when
+    in-flight hinted bytes exceed the budget, submission pauses until the
+    consumer drains — bounding store pressure instead of racing it."""
     pending: "collections.deque[ObjectRef]" = collections.deque()
+    in_flight_bytes = 0
+    sizes: Dict[Any, int] = {}
     exhausted = False
+
+    def over_budget() -> bool:
+        return budget_bytes is not None and in_flight_bytes > budget_bytes
+
     while True:
-        while not exhausted and len(pending) < max_in_flight:
+        while (not exhausted and len(pending) < max_in_flight
+               and not over_budget()):
             try:
-                pending.append(next(submit_iter))
+                item = next(submit_iter)
             except StopIteration:
                 exhausted = True
                 break
+            ref, size = item if isinstance(item, tuple) else (item, None)
+            pending.append(ref)
+            if budget_bytes is not None and size:
+                sizes[ref] = size
+                in_flight_bytes += size
         if not pending:
             return
         if preserve_order:
             head = pending.popleft()
             ray_tpu.wait([head], num_returns=1, timeout=None)
+            in_flight_bytes -= sizes.pop(head, 0)
             yield head
         else:
             ready, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=None)
             ref = ready[0]
             pending.remove(ref)
+            in_flight_bytes -= sizes.pop(ref, 0)
             yield ref
 
 
@@ -91,11 +119,25 @@ class MapStage(Stage):
         def apply(block):
             return block_fn(block)
 
-        def submitted() -> Iterator[ObjectRef]:
-            for ref in inputs:
-                yield apply.remote(ref)
+        from ray_tpu import api as _api
 
-        yield from _iter_completed(submitted(), self.max_in_flight)
+        runtime = _api.global_worker().runtime
+
+        def submitted() -> Iterator[Any]:
+            # size hints feed the byte budget; blocks within one dataset are
+            # near-uniform, so probe every 16th block instead of paying one
+            # control RPC per submit
+            est: Optional[int] = None
+            for i, ref in enumerate(inputs):
+                if i % 16 == 0:
+                    try:
+                        est = runtime.object_sizes([ref])[0] or est
+                    except Exception:  # noqa: BLE001
+                        pass
+                yield apply.remote(ref), est
+
+        yield from _iter_completed(submitted(), self.max_in_flight,
+                                   budget_bytes=_memory_budget_bytes())
 
     def _execute_actor_pool(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
         """Stateful transform: a pool of actors (reference:
@@ -165,30 +207,62 @@ def _exchange(inputs: Iterator[ObjectRef], num_outputs: Optional[int],
 
 
 class RepartitionStage(Stage):
+    """Order-preserving repartition (reference: shuffle=False repartition —
+    global row order is kept, so zip() after repartition stays aligned)."""
+
     def __init__(self, num_blocks: int):
         self.name = f"repartition({num_blocks})"
         self.num_blocks = num_blocks
 
     def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        def split(block, n, _idx=0):
+        input_refs = list(inputs)
+        if not input_refs:
+            return
+        n = self.num_blocks
+
+        @ray_tpu.remote(name="data::repartition_rows")
+        def count_rows(block):
+            return block.num_rows
+
+        counts = ray_tpu.get([count_rows.remote(r) for r in input_refs])
+        total = sum(counts)
+        per, rem = divmod(total, n)
+        # global output boundaries: output j covers rows [out_start[j], out_end[j])
+        out_sizes = [per + (1 if j < rem else 0) for j in range(n)]
+        out_bounds = []
+        acc = 0
+        for s in out_sizes:
+            out_bounds.append((acc, acc + s))
+            acc += s
+        # per-input-block slice plan: block i (global offset g) contributes
+        # its overlap with each output range, preserving order
+        offsets = []
+        g = 0
+        for c in counts:
+            offsets.append(g)
+            g += c
+        plans = []
+        for i, c in enumerate(counts):
+            g0, g1 = offsets[i], offsets[i] + c
+            plan = []
+            for j, (o0, o1) in enumerate(out_bounds):
+                lo, hi = max(g0, o0), min(g1, o1)
+                plan.append((lo - g0, max(lo, hi) - g0) if hi > lo else (0, 0))
+            plans.append(plan)
+
+        def split(block, n_, idx=0):
             from ray_tpu.data.block import BlockAccessor
 
-            acc = BlockAccessor(block)
-            total = block.num_rows
-            per, rem = divmod(total, n)
-            outs, start = [], 0
-            for i in range(n):
-                end = start + per + (1 if i < rem else 0)
-                outs.append(acc.slice(start, end))
-                start = end
-            return tuple(outs) if n > 1 else outs[0]
+            acc_ = BlockAccessor(block)
+            outs = [acc_.slice(s, e) for (s, e) in plans[idx]]
+            return tuple(outs) if n_ > 1 else outs[0]
 
         def reduce(_j, *parts):
             from ray_tpu.data.block import concat_blocks
 
-            return concat_blocks(list(parts))
+            return concat_blocks([p for p in parts if p.num_rows])
 
-        yield from _exchange(inputs, self.num_blocks, split, reduce)
+        yield from _exchange(iter(input_refs), n, split, reduce)
 
 
 class ShuffleStage(Stage):
@@ -221,6 +295,210 @@ class ShuffleStage(Stage):
             return combined.take(rng.permutation(combined.num_rows))
 
         yield from _exchange(inputs, None, split, reduce)
+
+
+class SortStage(Stage):
+    """Distributed range-partition sort (reference: planner/exchange/
+    sort_task_spec.py SortTaskSpec — sample boundaries, range-split map
+    tasks, sorted-merge reduce tasks)."""
+
+    def __init__(self, key: str, descending: bool = False,
+                 num_blocks: Optional[int] = None):
+        self.name = f"sort({key})"
+        self.key = key
+        self.descending = descending
+        self.num_blocks = num_blocks
+
+    def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
+        key, descending = self.key, self.descending
+        input_refs = list(inputs)
+        if not input_refs:
+            return
+        n_out = self.num_blocks or len(input_refs)
+
+        # 1. sample boundary candidates from every block (SortTaskSpec.
+        # sample_boundaries equivalent)
+        @ray_tpu.remote(name="data::sort_sample")
+        def sample(block):
+            import numpy as np
+
+            col = block.column(key).to_numpy(zero_copy_only=False)
+            if len(col) == 0:
+                return np.array([])
+            k = min(64, len(col))
+            idx = np.random.default_rng(0).choice(len(col), size=k, replace=False)
+            return col[idx]
+
+        samples = ray_tpu.get([sample.remote(r) for r in input_refs])
+        import numpy as np
+
+        flat = np.concatenate([s for s in samples if len(s)]) if any(
+            len(s) for s in samples) else np.array([0.0])
+        flat.sort()
+        # n_out-1 boundaries at even quantiles
+        bounds = flat[np.linspace(0, len(flat) - 1, n_out + 1)[1:-1].astype(int)] \
+            if n_out > 1 else np.array([])
+
+        def split(block, n, _idx=0):
+            import numpy as np
+
+            col = block.column(key).to_numpy(zero_copy_only=False)
+            assign = np.searchsorted(bounds, col, side="right")
+            if descending:
+                assign = (n - 1) - assign
+            outs = tuple(block.take(np.nonzero(assign == j)[0]) for j in range(n))
+            return outs if n > 1 else outs[0]
+
+        def reduce(_j, *parts):
+            import pyarrow.compute as pc
+
+            from ray_tpu.data.block import concat_blocks
+
+            combined = concat_blocks(list(parts))
+            order = "descending" if descending else "ascending"
+            return combined.take(pc.sort_indices(combined, sort_keys=[(key, order)]))
+
+        yield from _exchange(iter(input_refs), n_out, split, reduce)
+
+
+class AggregateStage(Stage):
+    """Hash-partition groupby + aggregate (reference: planner/exchange/
+    aggregate_task_spec.py): map tasks pre-combine per-group partials
+    (vectorized pyarrow group_by), reduce tasks merge partials and finalize.
+    With no keys, a single global-aggregate output block."""
+
+    def __init__(self, keys: List[str], aggs: List[Any],
+                 num_blocks: Optional[int] = None):
+        names = ",".join(a.name for a in aggs)
+        self.name = f"aggregate({','.join(keys) or '-'}:{names})"
+        self.keys = keys
+        self.aggs = aggs
+        self.num_blocks = num_blocks
+
+    def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
+        keys, aggs = self.keys, self.aggs
+        input_refs = list(inputs)
+        if not input_refs:
+            return
+        n_out = 1 if not keys else (self.num_blocks or min(len(input_refs), 8))
+
+        def split(block, n, _idx=0):
+            import numpy as np
+
+            from ray_tpu.data.aggregate import make_partial
+            from ray_tpu.data.block import BlockAccessor  # noqa: F401
+
+            partial = make_partial(block, keys, aggs)
+            if n == 1:
+                return partial
+            assign = _stable_hash_partition(partial, keys, n)
+            return tuple(partial.take(np.nonzero(assign == j)[0]) for j in range(n))
+
+        def reduce(_j, *parts):
+            from ray_tpu.data.aggregate import make_partial, merge_partials
+
+            # n_out==1 skips the split phase entirely (_exchange fast path):
+            # parts are then RAW blocks — combine them here
+            expected = {c for a in aggs for c, _ in a.merge_aggs()}
+            norm = [p if expected.issubset(set(p.column_names))
+                    else make_partial(p, keys, aggs) for p in parts]
+            return merge_partials(norm, keys, aggs)
+
+        yield from _exchange(iter(input_refs), n_out, split, reduce)
+
+
+def _stable_hash_partition(table, keys: List[str], n: int):
+    """Partition assignment stable ACROSS processes (python's str hash is
+    per-process salted; numpy splitmix for ints, crc32 for anything else)."""
+    import zlib
+
+    import numpy as np
+
+    h = np.zeros(table.num_rows, dtype=np.uint64)
+    for k in keys:
+        col = table.column(k)
+        try:
+            vals = col.to_numpy(zero_copy_only=False)
+        except Exception:  # noqa: BLE001
+            vals = np.array(col.to_pylist(), dtype=object)
+        if np.issubdtype(vals.dtype, np.integer):
+            x = vals.astype(np.uint64)
+            x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            h ^= x ^ (x >> np.uint64(31))
+        else:
+            h ^= np.array(
+                [zlib.crc32(str(v).encode()) for v in vals], dtype=np.uint64
+            )
+    return (h % np.uint64(n)).astype(np.int64)
+
+
+class ZipStage(Stage):
+    """Column-zip with another dataset's block stream (reference:
+    dataset.py Dataset.zip — aligns differing block boundaries, combines
+    columns; right-side name collisions get a _1 suffix)."""
+
+    def __init__(self, other_source: Callable[[], Iterator[ObjectRef]]):
+        self.name = "zip"
+        self.other_source = other_source
+
+    def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
+        left = list(inputs)
+        right = list(self.other_source())
+
+        @ray_tpu.remote(name="data::zip_rows")
+        def count_rows(block):
+            return block.num_rows
+
+        l_counts = ray_tpu.get([count_rows.remote(r) for r in left])
+        r_counts = ray_tpu.get([count_rows.remote(r) for r in right])
+        if sum(l_counts) != sum(r_counts):
+            raise ValueError(
+                f"zip(): datasets have different row counts "
+                f"({sum(l_counts)} vs {sum(r_counts)})"
+            )
+
+        # aligned segments: union of both sides' cumulative boundaries
+        def cum(counts):
+            out, acc = [], 0
+            for c in counts:
+                acc += c
+                out.append(acc)
+            return out
+
+        bounds = sorted(set(cum(l_counts)) | set(cum(r_counts)))
+
+        @ray_tpu.remote(name="data::zip_slice")
+        def zip_slice(lblock, loff, rblock, roff, length):
+            import pyarrow as pa
+
+            lpart = lblock.slice(loff, length)
+            rpart = rblock.slice(roff, length)
+            cols = {name: lpart.column(name) for name in lpart.column_names}
+            for name in rpart.column_names:
+                out_name = name if name not in cols else f"{name}_1"
+                cols[out_name] = rpart.column(name)
+            return pa.table(cols)
+
+        start = 0
+        for end in bounds:
+            length = end - start
+            if length <= 0:
+                continue
+            li, loff = _locate(l_counts, start)
+            ri, roff = _locate(r_counts, start)
+            yield zip_slice.remote(left[li], loff, right[ri], roff, length)
+            start = end
+
+
+def _locate(counts: List[int], global_row: int):
+    """(block index, offset within block) of a global row index."""
+    acc = 0
+    for i, c in enumerate(counts):
+        if global_row < acc + c:
+            return i, global_row - acc
+        acc += c
+    raise IndexError(global_row)
 
 
 class StageStats:

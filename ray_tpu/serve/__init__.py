@@ -11,6 +11,7 @@ from ray_tpu.serve.api import (
     get_app_handle,
     get_deployment_handle,
     http_address,
+    http_addresses,
     run,
     shutdown,
     start,
@@ -32,6 +33,7 @@ __all__ = [
     "get_app_handle",
     "get_deployment_handle",
     "http_address",
+    "http_addresses",
     "run",
     "shutdown",
     "start",

@@ -27,8 +27,14 @@ _state: Dict[str, Any] = {"controller": None, "proxy": None}
 
 
 def start(http_host: str = "127.0.0.1", http_port: int = 8000,
-          http: bool = True):
-    """Idempotently start the serve instance (controller + proxy actors)."""
+          http: bool = True, proxy_location: str = "head"):
+    """Idempotently start the serve instance (controller + proxy actors).
+
+    ``proxy_location``: "head" (one proxy on the starting node) or
+    "every_node" — one HTTP proxy pinned to each alive node (reference:
+    _private/proxy_state.py per-node ProxyStateManager). With every_node,
+    pass http_port=0 for ephemeral ports (required on one-box test
+    clusters where every "node" shares the same host)."""
     if not ray_tpu.is_initialized():
         ray_tpu.init()
     controller = _state.get("controller")
@@ -39,30 +45,60 @@ def start(http_host: str = "127.0.0.1", http_port: int = 8000,
             controller = (
                 ray_tpu.remote(ServeController)
                 .options(name=_CONTROLLER_NAME, namespace=_NAMESPACE,
-                         max_concurrency=32)
+                         # long-poll listeners each hold a call slot for up
+                         # to 30 s; size well above expected router count
+                         max_concurrency=128)
                 .remote()
             )
         _state["controller"] = controller
-    if http and _state.get("proxy") is None:
-        try:
-            proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=_NAMESPACE)
-        except ValueError:
-            proxy = (
-                ray_tpu.remote(ProxyActor)
-                .options(name=_PROXY_NAME, namespace=_NAMESPACE, max_concurrency=8)
-                .remote(controller, http_host, http_port)
-            )
-        _state["proxy"] = proxy
+    if http and not _state.get("proxies"):
+        proxies = []
+        if proxy_location == "every_node":
+            from ray_tpu.core.resources import NodeAffinitySchedulingStrategy
+            from ray_tpu.util import state as _st
+
+            for n in _st.list_nodes():
+                if not n.get("Alive"):
+                    continue
+                name = f"{_PROXY_NAME}:{n['NodeID'][:12]}"
+                try:
+                    p = ray_tpu.get_actor(name, namespace=_NAMESPACE)
+                except ValueError:
+                    p = (
+                        ray_tpu.remote(ProxyActor)
+                        .options(
+                            name=name, namespace=_NAMESPACE, max_concurrency=8,
+                            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                                n["NodeID"]),
+                        )
+                        .remote(controller, http_host, http_port)
+                    )
+                proxies.append(p)
+        else:
+            try:
+                p = ray_tpu.get_actor(_PROXY_NAME, namespace=_NAMESPACE)
+            except ValueError:
+                p = (
+                    ray_tpu.remote(ProxyActor)
+                    .options(name=_PROXY_NAME, namespace=_NAMESPACE,
+                             max_concurrency=8)
+                    .remote(controller, http_host, http_port)
+                )
+            proxies.append(p)
+        _state["proxy"] = proxies[0] if proxies else None
+        _state["proxies"] = proxies
     return controller
 
 
 def run(app: Application, name: Optional[str] = None, *,
         http: bool = True, http_port: int = 8000,
+        proxy_location: str = "head",
         wait_for_ready: bool = True, timeout: float = 120.0) -> DeploymentHandle:
     """Deploy an application; returns its handle (reference: serve.run)."""
     if isinstance(app, Deployment):
         app = app.bind()
-    controller = start(http_port=http_port, http=http)
+    controller = start(http_port=http_port, http=http,
+                       proxy_location=proxy_location)
     app_name = name or app.deployment.name
     ray_tpu.get(
         controller.deploy.remote(
@@ -106,6 +142,12 @@ def http_address() -> Optional[str]:
     return ray_tpu.get(proxy.address.remote(), timeout=30)
 
 
+def http_addresses() -> list:
+    """Every proxy's address (one per node with proxy_location="every_node")."""
+    return [ray_tpu.get(p.address.remote(), timeout=30)
+            for p in _state.get("proxies") or []]
+
+
 def delete(name: str) -> None:
     controller = _state.get("controller")
     if controller is not None:
@@ -114,18 +156,21 @@ def delete(name: str) -> None:
 
 def shutdown() -> None:
     controller = _state.pop("controller", None)
-    proxy = _state.pop("proxy", None)
+    _state.pop("proxy", None)
+    proxies = _state.pop("proxies", None) or []
     if controller is not None:
         try:
             ray_tpu.get(controller.shutdown.remote(), timeout=30)
             ray_tpu.kill(controller)
         except Exception:  # noqa: BLE001
             pass
-    if proxy is not None:
+    for proxy in proxies:
         try:
             ray_tpu.kill(proxy)
         except Exception:  # noqa: BLE001
             pass
     from ray_tpu.serve import handle as _handle
 
+    for r in _handle._routers.values():
+        r.stop()
     _handle._routers.clear()

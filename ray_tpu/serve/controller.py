@@ -35,10 +35,39 @@ class ServeController:
         self._apps: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.RLock()
         self._shutdown = False
+        # versioned config bus (reference: serve/long_poll.py LongPollHost):
+        # every replica-set change bumps the version and wakes blocked
+        # listen_for_change calls — routers get pushed updates instead of
+        # polling + probing every replica
+        self._version = 1
+        self._version_cv = threading.Condition()
         self._loop_thread = threading.Thread(
             target=self._control_loop, daemon=True, name="serve-control-loop"
         )
         self._loop_thread.start()
+
+    def _bump_version(self) -> None:
+        with self._version_cv:
+            self._version += 1
+            self._version_cv.notify_all()
+
+    def listen_for_change(self, app_name: str, known_version: int,
+                          timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Long-poll: returns as soon as the config version exceeds
+        known_version (or at timeout with the current state). Payload is the
+        app's live replica set — everything a router needs."""
+        with self._version_cv:
+            self._version_cv.wait_for(
+                lambda: self._version > known_version or self._shutdown,
+                timeout=timeout_s,
+            )
+        with self._lock:
+            rec = self._apps.get(app_name)
+            return {
+                "version": self._version,
+                "exists": rec is not None,
+                "replicas": list(rec["replicas"]) if rec else [],
+            }
 
     # ------------------------------------------------------------ target API
     def deploy(self, app_name: str, deployment_def: bytes, init_args: bytes) -> bool:
@@ -68,11 +97,13 @@ class ServeController:
                             r.reconfigure.remote(dep.user_config)
                         except Exception:  # noqa: BLE001
                             pass
+        self._bump_version()
         return True
 
     def delete_app(self, app_name: str) -> bool:
         with self._lock:
             rec = self._apps.pop(app_name, None)
+        self._bump_version()
         if rec:
             for r in rec["replicas"]:
                 self._stop_replica(r)
@@ -172,6 +203,7 @@ class ServeController:
                 for r in dead:
                     if r in rec["replicas"]:
                         rec["replicas"].remove(r)
+            self._bump_version()
             logger.warning("serve app %s: %d replica(s) failed health check",
                            name, len(dead))
 
@@ -210,18 +242,40 @@ class ServeController:
         with self._lock:
             target = rec["target"]
             current = len(rec["replicas"])
+        changed = False
         for _ in range(current, target):
             replica = self._start_replica(name, rec)
             if replica is None:
                 break
             with self._lock:
                 rec["replicas"].append(replica)
+            changed = True
         if current > target:
             with self._lock:
                 victims = rec["replicas"][target:]
                 rec["replicas"] = rec["replicas"][:target]
+            changed = True
+            # victims left the replica set (and the push below tells every
+            # router) BEFORE they stop: drain in the background so no
+            # in-flight request is lost (reference: proxy_state.py draining)
             for r in victims:
-                self._stop_replica(r)
+                threading.Thread(
+                    target=self._drain_then_stop, args=(r,),
+                    daemon=True, name="serve-drain",
+                ).start()
+        if changed:
+            self._bump_version()
+
+    def _drain_then_stop(self, replica, drain_timeout_s: float = 30.0) -> None:
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                if ray_tpu.get(replica.stats.remote(), timeout=5)["ongoing"] <= 0:
+                    break
+            except Exception:  # noqa: BLE001 - already dead: nothing to drain
+                break
+            time.sleep(0.1)
+        self._stop_replica(replica)
 
     def _start_replica(self, name: str, rec: Dict[str, Any]):
         from ray_tpu.serve.replica import Replica

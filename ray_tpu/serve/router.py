@@ -20,44 +20,70 @@ from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("serve.router")
 
-REFRESH_PERIOD_S = 2.0
-
 
 class Router:
+    """Routers subscribe to the controller's versioned config bus
+    (reference: serve/long_poll.py LongPollClient): a daemon thread blocks
+    in listen_for_change and applies pushed replica-set updates — config
+    changes propagate in one RPC latency, with no periodic probing of every
+    replica (the old 2 s poll + O(replicas) stats storm)."""
+
     def __init__(self, controller, app_name: str):
         self._controller = controller
         self._app = app_name
         self._replicas: List[Any] = []
         self._queue_len: Dict[Any, int] = {}  # cached estimates per handle
-        self._last_refresh = 0.0
+        self._version = 0
+        self._synced = threading.Event()
+        self._stopped = False
         self._lock = threading.Lock()
+        self._listener = threading.Thread(
+            target=self._listen_loop, daemon=True, name=f"router-poll-{app_name}"
+        )
+        self._listener.start()
 
     # ---------------------------------------------------------- replica set
-    def _refresh(self, force: bool = False) -> None:
-        now = time.monotonic()
+    def stop(self) -> None:
+        """Stop the long-poll listener (serve.shutdown path)."""
+        self._stopped = True
+
+    def _apply(self, update: Dict[str, Any]) -> None:
         with self._lock:
-            if not force and now - self._last_refresh < REFRESH_PERIOD_S and self._replicas:
-                return
-            self._last_refresh = now
-        try:
-            replicas = ray_tpu.get(
-                self._controller.get_replicas.remote(self._app), timeout=10
-            )
-        except Exception:  # noqa: BLE001 - controller briefly unavailable
-            logger.warning("router: replica refresh failed for %s", self._app)
-            return
-        # probe live queue lengths (corrects drift from fire-and-forget
-        # handle submissions whose completion the router never observes)
-        probes = [(r, r.stats.remote()) for r in replicas]
-        fresh: Dict[Any, int] = {}
-        for r, ref in probes:
+            self._version = update["version"]
+            new = list(update["replicas"])
+            # keep queue estimates for survivors; new replicas start at 0
+            self._queue_len = {r: self._queue_len.get(r, 0) for r in new}
+            self._replicas = new
+        self._synced.set()
+
+    def _listen_loop(self) -> None:
+        backoff = 0.1
+        while not self._stopped:
             try:
-                fresh[r] = int(ray_tpu.get(ref, timeout=2)["ongoing"])
-            except Exception:  # noqa: BLE001 - dead/slow replica: keep stale
-                fresh[r] = self._queue_len.get(r, 0)
-        with self._lock:
-            self._replicas = list(replicas)
-            self._queue_len = fresh
+                update = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._app, self._version, timeout_s=30.0
+                    ),
+                    timeout=45,
+                )
+                self._apply(update)
+                backoff = 0.1
+            except Exception:  # noqa: BLE001 - controller restarting/busy
+                if self._stopped:
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    def _refresh(self, force: bool = False) -> None:
+        """Wait for the first pushed config; after an eviction (``force``)
+        wait briefly for a fresh push, but don't stall the retry loop — the
+        local eviction already removed the dead replica."""
+        if force:
+            self._synced.clear()
+            self._synced.wait(timeout=0.5)
+            self._synced.set()  # never wedge future non-force waits
+            return
+        self._synced.wait(timeout=10.0)
 
     def _pick(self) -> Any:
         """Pow-2: two random candidates, lower cached queue length wins."""

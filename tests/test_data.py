@@ -166,3 +166,97 @@ def test_stats_reports_stages():
     report = ds.stats()
     assert "map" in report and "random_shuffle" in report
     assert "wall_s" in report
+
+
+# ---------------------------------------------------------------- all-to-all
+# (reference: planner/exchange/ sort/aggregate task specs, grouped_data.py)
+
+def test_sort_ascending_descending():
+    import random
+
+    vals = list(range(200))
+    random.Random(7).shuffle(vals)
+    ds = rd.from_items([{"v": v} for v in vals]).repartition(8)
+    out = [r["v"] for r in ds.sort("v").take_all()]
+    assert out == sorted(vals)
+    out_d = [r["v"] for r in ds.sort("v", descending=True).take_all()]
+    assert out_d == sorted(vals, reverse=True)
+
+
+def test_sort_string_keys():
+    names = [f"row-{i:03d}" for i in range(50)]
+    import random
+
+    shuffled = names[:]
+    random.Random(3).shuffle(shuffled)
+    ds = rd.from_items([{"name": n} for n in shuffled]).repartition(4)
+    assert [r["name"] for r in ds.sort("name").take_all()] == names
+
+
+def test_groupby_count_sum_mean():
+    rows = [{"k": i % 3, "v": float(i)} for i in range(30)]
+    ds = rd.from_items(rows).repartition(5)
+    counted = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counted == {0: 10, 1: 10, 2: 10}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    expect = {k: sum(i for i in range(30) if i % 3 == k) for k in range(3)}
+    assert sums == expect
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    for k in range(3):
+        assert means[k] == pytest.approx(expect[k] / 10)
+
+
+def test_groupby_min_max_std():
+    rows = [{"k": "a" if i < 10 else "b", "v": float(i)} for i in range(20)]
+    ds = rd.from_items(rows).repartition(3)
+    mins = {r["k"]: r["min(v)"] for r in ds.groupby("k").min("v").take_all()}
+    maxs = {r["k"]: r["max(v)"] for r in ds.groupby("k").max("v").take_all()}
+    assert mins == {"a": 0.0, "b": 10.0}
+    assert maxs == {"a": 9.0, "b": 19.0}
+    stds = {r["k"]: r["std(v)"] for r in ds.groupby("k").std("v").take_all()}
+    assert stds["a"] == pytest.approx(np.std(np.arange(10.0), ddof=1))
+
+
+def test_global_aggregates():
+    ds = rd.from_items([{"v": float(i)} for i in range(100)]).repartition(7)
+    assert ds.sum("v") == pytest.approx(4950.0)
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 99.0
+    assert ds.mean("v") == pytest.approx(49.5)
+    assert ds.std("v") == pytest.approx(np.std(np.arange(100.0), ddof=1))
+
+
+def test_unique_and_map_groups():
+    rows = [{"k": i % 4, "v": i} for i in range(40)]
+    ds = rd.from_items(rows).repartition(4)
+    assert ds.unique("k") == [0, 1, 2, 3]
+
+    def normalize(batch):
+        v = batch["v"].astype(np.float64)
+        return {"k": batch["k"], "v": v - v.mean()}
+
+    out = ds.groupby("k").map_groups(normalize).take_all()
+    assert len(out) == 40
+    by_k = {}
+    for r in out:
+        by_k.setdefault(r["k"], []).append(r["v"])
+    for k, vs in by_k.items():
+        assert sum(vs) == pytest.approx(0.0)
+
+
+def test_zip_aligned_and_misaligned_blocks():
+    left = rd.from_items([{"a": i} for i in range(30)]).repartition(3)
+    right = rd.from_items([{"b": i * 2} for i in range(30)]).repartition(5)
+    out = left.zip(right).take_all()
+    assert len(out) == 30
+    assert all(r["b"] == r["a"] * 2 for r in out)
+    # name collision: right-side column gets _1 suffix
+    both = left.zip(rd.from_items([{"a": -i} for i in range(30)]).repartition(2)).take_all()
+    assert all(r["a_1"] == -r["a"] for r in both)
+
+
+def test_zip_row_count_mismatch_raises():
+    left = rd.from_items([{"a": i} for i in range(5)])
+    right = rd.from_items([{"b": i} for i in range(6)])
+    with pytest.raises(ValueError):
+        left.zip(right).take_all()

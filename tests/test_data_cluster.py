@@ -1,0 +1,93 @@
+"""Data at cluster scale: distributed sort of 1e6 rows over 3 nodes, and
+a pipeline whose blocks exceed the object-store budget by 10x (completes via
+spill + byte-budget backpressure).
+(reference: planner/exchange/ sort family, execution/resource_manager.py)"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.cluster import Cluster
+
+STORE_MB = 48
+
+
+@pytest.fixture(scope="module")
+def data_cluster():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2,
+                        "object_store_memory": STORE_MB * 1024 * 1024},
+    )
+    for _ in range(2):
+        c.add_node(num_cpus=2, object_store_memory=STORE_MB * 1024 * 1024)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_distributed_sort_1m_rows(data_cluster):
+    n = 1_000_000
+    rng = np.random.default_rng(42)
+    vals = rng.permutation(n)
+
+    # 12 source blocks spread over the cluster
+    chunks = np.array_split(vals, 12)
+
+    def source():
+        for c in chunks:
+            yield ray_tpu.put(
+                __import__("pyarrow").table({"v": c.astype(np.int64)})
+            )
+
+    from ray_tpu.data.dataset import Dataset
+
+    ds = Dataset(source).sort("v")
+    prev_max = -1
+    total = 0
+    for ref in ds.iter_internal_refs():
+        block = ray_tpu.get(ref)
+        col = block.column("v").to_numpy()
+        if len(col) == 0:
+            continue
+        assert np.all(np.diff(col) >= 0), "block not internally sorted"
+        assert col[0] >= prev_max, "blocks not globally ordered"
+        prev_max = int(col[-1])
+        total += len(col)
+    assert total == n
+
+
+def test_map_10x_store_budget_completes_via_spill(data_cluster):
+    # the previous test's blocks free after the distributed-GC grace window;
+    # wait for the store to drain so this test measures ITS OWN pressure
+    import time
+
+    time.sleep(2 * 2.0 + 2.0)  # 2x object_ref_grace_s + flush slack
+
+    # 40 blocks x ~12 MB float64 = ~480 MB through a 48 MB store
+    block_rows = 1_500_000
+    n_blocks = 40
+
+    def source():
+        for i in range(n_blocks):
+            yield ray_tpu.put(
+                __import__("pyarrow").table(
+                    {"x": np.full(block_rows, float(i), dtype=np.float64)}
+                )
+            )
+
+    from ray_tpu.data.dataset import Dataset
+
+    ds = Dataset(source).map_batches(lambda b: {"x": b["x"] + 1.0})
+    seen = 0
+    for ref in ds.iter_internal_refs():
+        block = ray_tpu.get(ref)
+        assert block.num_rows == block_rows
+        seen += 1
+        del block, ref  # drop refs promptly so the store can evict
+    assert seen == n_blocks

@@ -1,0 +1,89 @@
+"""Slice-shaped autoscaling e2e: a 16-chip gang demand provisions 4 fake
+hosts as ONE slice (atomic group), and idle scale-down drains the whole
+group before terminating it.
+(reference: autoscaler/v2/instance_manager/, fake_multi_node
+node_provider.py:236, TPU queued-resource slice semantics.)"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    FakeCloudProvider, InstanceManager, SliceAutoscaler, SliceAutoscalerConfig,
+)
+from ray_tpu.autoscaler.instance_manager import RUNNING, TERMINATED
+from ray_tpu.cluster import Cluster
+from ray_tpu.core.rpc import SyncRpcClient
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+
+@pytest.fixture(scope="module")
+def slice_cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=c.gcs_address)
+    provider = FakeCloudProvider(c.gcs_address, session_dir=c.session_dir,
+                                 provision_delay_s=0.3)
+    gcs = SyncRpcClient(c.gcs_address)
+    manager = InstanceManager(provider, gcs_call=gcs.call)
+    scaler = SliceAutoscaler(
+        c.gcs_address, manager,
+        SliceAutoscalerConfig(
+            max_groups=1,
+            group_config={"hosts": 4, "num_cpus": 1, "num_tpus": 4,
+                          "slice_label": "v5e-16"},
+            idle_timeout_s=5.0, update_interval_s=0.5,
+        ),
+    )
+    scaler.start()
+    yield c, provider, manager, scaler, gcs
+    scaler.stop()
+    for inst in provider.instances():
+        provider.terminate(inst)
+    gcs.close()
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_slice_gang_scales_up_then_drains_down(slice_cluster):
+    c, provider, manager, scaler, gcs = slice_cluster
+
+    # 16-chip gang: head has no TPUs, so this PENDS and feeds demand
+    pg = placement_group([{"CPU": 1, "TPU": 4}] * 4, strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=120), "slice gang never became ready"
+    assert scaler.groups_launched == 1
+
+    # the 4 bundles must land on 4 hosts sharing ONE slice label
+    info = gcs.call("placement_group_info", pg_id=pg.id.hex())
+    nodes = {n["NodeID"]: n["Labels"].get("ray_tpu.io/slice")
+             for n in gcs.call("get_nodes")}
+    assert len(set(info["placement"])) == 4, info["placement"]
+    slices = {nodes[n] for n in info["placement"]}
+    assert len(slices) == 1 and None not in slices, slices
+
+    # run a gang task on the slice to prove it serves work
+    from ray_tpu.core.resources import PlacementGroupSchedulingStrategy
+
+    @ray_tpu.remote(num_tpus=4, scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg))
+    def on_slice():
+        import os
+
+        return os.environ.get("TPU_VISIBLE_CHIPS", "")
+
+    assert ray_tpu.get(on_slice.remote(), timeout=120) is not None
+
+    # release the gang: the idle group must DRAIN (all 4 at once) + terminate
+    remove_placement_group(pg)
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        states = {i.state for i in provider.instances()}
+        if states == {TERMINATED}:
+            break
+        time.sleep(0.5)
+    assert {i.state for i in provider.instances()} == {TERMINATED}
+    assert scaler.groups_terminated == 1
+    # the GCS saw a drain for every host before termination
+    alive = [n for n in gcs.call("get_nodes")
+             if n["Alive"] and not n.get("is_head")]
+    assert not alive or time.monotonic() < deadline

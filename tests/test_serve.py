@@ -209,3 +209,93 @@ def test_llm_deployment_via_serve(serve_instance):
     assert len(out["tokens"]) == 4
     stats = handle.engine_stats.remote().result(timeout=30)
     assert stats["tokens_generated"] >= 4
+
+
+# ------------------------------------------------- long-poll config bus
+# (reference: serve/long_poll.py, _private/proxy_state.py draining)
+
+def test_config_change_propagates_fast(serve_instance):
+    """Scale-up must reach routers via the long-poll push well under the old
+    2 s polling period — no probe storm, one push latency."""
+    @serve.deployment(num_replicas=1)
+    class WhoAmI:
+        def __init__(self):
+            import uuid
+
+            self.uid = uuid.uuid4().hex
+
+        def __call__(self, _=None):
+            return self.uid
+
+    handle = serve.run(WhoAmI.bind(), name="scaleapp", http=False)
+    assert handle.remote(None).result(timeout=30)
+
+    from ray_tpu.serve.handle import _routers
+
+    router = _routers["scaleapp"]
+    v0 = router._version
+    # push a config change: 1 -> 3 replicas
+    serve.run(
+        WhoAmI.options(num_replicas=3).bind(), name="scaleapp", http=False,
+        wait_for_ready=False,
+    )
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(router._replicas) < 3:
+        time.sleep(0.02)
+    lag = time.monotonic() - (deadline - 10)
+    assert len(router._replicas) == 3, "router never saw the scale-up"
+    # the control loop reconciles every 0.5 s; the push itself adds ~one RPC.
+    # Allow generous slack for the 1-core CI box, still far under 2 s polling.
+    assert lag < 5.0
+
+
+def test_rolling_scale_down_loses_no_inflight_requests(serve_instance):
+    """Scale-down drains: a victim replica finishes its in-flight requests
+    before stopping (reference: replica draining in proxy_state.py)."""
+    @serve.deployment(num_replicas=3, max_ongoing_requests=4)
+    class Slow:
+        def __call__(self, i):
+            time.sleep(1.0)
+            return i
+
+    handle = serve.run(Slow.bind(), name="drainapp", http=False)
+    # fill all replicas with in-flight work
+    resps = [handle.remote(i) for i in range(9)]
+    time.sleep(0.2)  # let requests land on replicas
+    # shrink while they run
+    serve.run(Slow.options(num_replicas=1).bind(), name="drainapp",
+              http=False, wait_for_ready=False)
+    results = sorted(r.result(timeout=60) for r in resps)
+    assert results == list(range(9)), f"lost requests: {results}"
+
+
+def test_per_node_proxies_cluster():
+    """proxy_location='every_node': one HTTP proxy per node, all serving."""
+    import os
+    import urllib.request as _rq
+
+    from ray_tpu.cluster import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        @serve.deployment
+        def ident(x):
+            return x
+
+        serve.run(ident.bind(), name="ident", http=True, http_port=0,
+                  proxy_location="every_node")
+        addrs = serve.http_addresses()
+        assert len(addrs) == 2, addrs
+        for addr in addrs:
+            req = _rq.Request(
+                f"{addr}/ident", data=json.dumps(7).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            body = json.loads(_rq.urlopen(req, timeout=30).read())
+            assert body == 7, body
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        c.shutdown()
