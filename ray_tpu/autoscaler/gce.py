@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import shutil
 import subprocess
+import time
 import uuid
 from typing import Any, Dict, List
 
@@ -65,13 +66,29 @@ class GceTpuProvider(CloudProvider):
             "instance-id)"
         )
         self._instances: Dict[str, Instance] = {}
+        # groups whose gcloud delete failed: retried by poll() until it
+        # lands (the group is already drained, so nothing else re-triggers
+        # terminate() for it). gid -> earliest next retry time; the backoff
+        # keeps a hanging delete (300s subprocess timeout) from stalling
+        # every poll cycle.
+        self._pending_deletes: Dict[str, float] = {}
+        self.delete_retry_s = 60.0
+        # group id -> consecutive polls absent from `tpu-vm list` (grace
+        # against transiently partial/empty list responses)
+        self._missing_polls: Dict[str, int] = {}
 
     def _gcloud(self, *args: str) -> Any:
-        out = subprocess.run(
-            ["gcloud", *args, "--project", self.project, "--zone", self.zone,
-             "--format", "json"],
-            capture_output=True, text=True, timeout=300,
-        )
+        try:
+            out = subprocess.run(
+                ["gcloud", *args, "--project", self.project, "--zone",
+                 self.zone, "--format", "json"],
+                capture_output=True, text=True, timeout=300,
+            )
+        except subprocess.SubprocessError as e:
+            # normalize hangs (TimeoutExpired) etc. into the RuntimeError the
+            # retry machinery catches — a hung delete must still enter
+            # _pending_deletes
+            raise RuntimeError(f"gcloud {' '.join(args[:3])}: {e!r}") from e
         if out.returncode != 0:
             raise RuntimeError(f"gcloud {' '.join(args[:3])}: {out.stderr[:500]}")
         return json.loads(out.stdout or "null")
@@ -103,25 +120,89 @@ class GceTpuProvider(CloudProvider):
             logger.exception("tpu-vm list failed")
             return
         states = {n["name"].rsplit("/", 1)[-1]: n.get("state", "") for n in listed}
+        live_groups = {i.group_id for i in self._instances.values()
+                       if i.state not in (TERMINATED, FAILED)}
+        for gid in live_groups:
+            if gid in states:
+                self._missing_polls.pop(gid, None)
+            else:
+                self._missing_polls[gid] = self._missing_polls.get(gid, 0) + 1
+        # retry failed deletes (the group was already drained, so no other
+        # path re-issues them). A pending group confirmed absent — the same
+        # 2-consecutive-poll grace as below, so one partial listing can't
+        # leak a live VM — is already gone server-side; don't shell out a
+        # doomed NOT_FOUND delete for it.
+        for gid, next_retry in list(self._pending_deletes.items()):
+            if gid not in states and self._missing_polls.get(gid, 0) >= 2:
+                self._pending_deletes.pop(gid, None)
+                self._finish_group(gid)
+            elif gid in states and time.monotonic() >= next_retry:
+                if self._try_delete(gid):
+                    self._pending_deletes.pop(gid, None)
+                    self._finish_group(gid)
+                else:
+                    # recompute the clock AFTER the attempt: a delete that
+                    # blocked to its 300s subprocess timeout must still get
+                    # a full backoff window, not an already-expired one
+                    self._pending_deletes[gid] = (
+                        time.monotonic() + self.delete_retry_s)
         for inst in self._instances.values():
             if inst.state in (TERMINATED, FAILED):
                 continue
+            if inst.group_id in self._pending_deletes:
+                # delete in flight: freeze the state machine so a still-READY
+                # listing can't resurrect a drained slice back to RUNNING
+                continue
             cloud_state = states.get(inst.group_id)
-            mapped = _STATE_MAP.get(cloud_state or "", inst.state)
+            if cloud_state is None:
+                # the TPU VM is absent from the listing. A REQUESTED instance
+                # may simply not appear yet; for anything past that, require
+                # two consecutive absent polls (one transient partial/empty
+                # list response must not strand a live slice) before
+                # declaring it externally deleted.
+                if inst.state != REQUESTED and \
+                        self._missing_polls.get(inst.group_id, 0) >= 2:
+                    inst.transition(TERMINATED)
+                continue
+            mapped = _STATE_MAP.get(cloud_state, inst.state)
             if mapped != inst.state:
                 inst.transition(mapped)
+        # drop counters for groups with no live instances left (group names
+        # are fresh uuids, so stale entries would otherwise accumulate)
+        still_live = {i.group_id for i in self._instances.values()
+                      if i.state not in (TERMINATED, FAILED)}
+        for gid in list(self._missing_polls):
+            if gid not in still_live:
+                del self._missing_polls[gid]
 
-    def terminate(self, instance: Instance) -> None:
-        # deleting the TPU VM removes every host of the slice
-        peers = [i for i in self._instances.values()
-                 if i.group_id == instance.group_id and i.state != TERMINATED]
+    def _try_delete(self, group_id: str) -> bool:
         try:
             self._gcloud("compute", "tpus", "tpu-vm", "delete",
-                         instance.group_id, "--quiet")
+                         group_id, "--quiet")
+            return True
         except RuntimeError:
-            logger.exception("tpu-vm delete failed for %s", instance.group_id)
-        for p in peers:
-            p.transition(TERMINATED)
+            logger.exception("tpu-vm delete failed for %s", group_id)
+            return False
+
+    def _finish_group(self, group_id: str) -> None:
+        self._missing_polls.pop(group_id, None)
+        for p in self._instances.values():
+            if p.group_id == group_id and p.state != TERMINATED:
+                p.transition(TERMINATED)
+
+    def terminate(self, instance: Instance) -> None:
+        # deleting the TPU VM removes every host of the slice; peers are
+        # transitioned together, so later terminate() calls for the same
+        # group fast-path out here
+        if instance.state == TERMINATED:
+            return
+        gid = instance.group_id
+        if gid in self._pending_deletes:
+            return  # delete already queued; poll() keeps retrying it
+        if self._try_delete(gid):
+            self._finish_group(gid)
+        else:
+            self._pending_deletes[gid] = time.monotonic() + self.delete_retry_s
 
     def instances(self) -> List[Instance]:
         return list(self._instances.values())

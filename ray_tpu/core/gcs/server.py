@@ -788,6 +788,11 @@ class GcsServer:
         self, object_id: str, size: int, node_id: str, owner: str = "",
         contained: Optional[List[str]] = None,
     ) -> bool:
+        if object_id in self._freed_tombstones:
+            # freed while this registration was in flight (direct path is
+            # RETRY_SAFE, so a transparent retry can land after a
+            # free_object_everywhere): stay dead, never resurrect
+            return True
         rec = self.objects.setdefault(
             object_id, {"size": size, "locations": set(), "owner": owner}
         )
@@ -844,9 +849,7 @@ class GcsServer:
         task-return seal; reference: flushed location updates in the
         ownership protocol)."""
         for i, r in enumerate(regs):
-            if r["object_id"] in self._freed_tombstones:
-                continue  # freed while the registration was queued: stay dead
-            await self.rpc_register_object(**r)
+            await self.rpc_register_object(**r)  # tombstone-checked inside
             if i % 100 == 99:
                 await asyncio.sleep(0)  # big batch: let heartbeats interleave
         return True

@@ -11,7 +11,6 @@ the root's ObjectRef.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
@@ -156,7 +155,6 @@ class ClassNode(DAGNode):
         self._cls = actor_cls
         self._args = args
         self._kwargs = kwargs
-        self._lock = threading.Lock()
 
     def _children(self) -> List[DAGNode]:
         return _collect_children(self._args, self._kwargs)

@@ -260,7 +260,12 @@ class RepartitionStage(Stage):
         def reduce(_j, *parts):
             from ray_tpu.data.block import concat_blocks
 
-            return concat_blocks([p for p in parts if p.num_rows])
+            nonempty = [p for p in parts if p.num_rows]
+            if not nonempty and parts:
+                # an output partition with no rows must still carry the
+                # schema: a column-less block breaks downstream column refs
+                return parts[0].slice(0, 0)
+            return concat_blocks(nonempty)
 
         yield from _exchange(iter(input_refs), n, split, reduce)
 

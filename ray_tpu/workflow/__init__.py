@@ -76,6 +76,7 @@ class WorkflowExecution:
         os.makedirs(self.steps_dir, exist_ok=True)
         # deterministic step ids: depth-first order over the (stable) graph
         self._order = {id(n): i for i, n in enumerate(dag.walk())}
+        self._inputs: Optional[tuple] = None  # (args, kwargs) of this run
 
     # ------------------------------------------------------------- metadata
     def _write_meta(self, status: str, error: str = "") -> None:
@@ -84,6 +85,9 @@ class WorkflowExecution:
             "status": status,
             "error": error,
             "dag": self.dag,
+            # original run() (args, kwargs): replayed by resume() so
+            # InputNode steps see the same inputs on every attempt
+            "inputs": self._inputs,
             "updated_at": time.time(),
         }))
 
@@ -99,6 +103,7 @@ class WorkflowExecution:
             return cloudpickle.loads(f.read())
 
     def run(self, *args, **kwargs) -> Any:
+        self._inputs = (args, kwargs)
         self._write_meta("RUNNING")
         try:
             result = self._run_node(self.dag, args, kwargs)
@@ -146,7 +151,16 @@ class WorkflowExecution:
                 return value
             if isinstance(n, (ClassNode, ClassMethodNode)):
                 # actor steps are not durable (reference: workflows support
-                # virtual actors separately); execute live each run
+                # virtual actors separately); execute live each run. But their
+                # DAG-node arguments MUST resolve through this checkpoint-
+                # aware path first — seeding ctx.memo so the live _resolve
+                # below picks up checkpointed values instead of re-running
+                # function parents (duplicate side effects on resume).
+                for child in n.walk():
+                    if child is n or isinstance(child, (ClassNode, ClassMethodNode)):
+                        continue  # actor chain stays live; resolved below
+                    if child not in ctx.memo:
+                        ctx.memo[child] = resolve(child)
                 value = ray_tpu.get(n._resolve(ctx)) if isinstance(
                     n, ClassMethodNode) else n._resolve(ctx)
                 memo_values[id(n)] = value
@@ -176,7 +190,20 @@ def resume(workflow_id: str) -> Any:
         raise ValueError(f"no workflow '{workflow_id}' in {_root()}")
     with open(meta_path, "rb") as f:
         meta = cloudpickle.loads(f.read())
-    return WorkflowExecution(workflow_id, meta["dag"]).run()
+    dag = meta["dag"]
+    inputs = meta.get("inputs")
+    if inputs is None:
+        from ray_tpu.dag import InputNode
+
+        if any(isinstance(n, InputNode) for n in dag.walk()):
+            raise ValueError(
+                f"workflow '{workflow_id}' has an InputNode but no recorded "
+                "run() inputs (written by an older version?); cannot resume "
+                "without the original arguments"
+            )
+        inputs = ((), {})
+    args, kwargs = inputs
+    return WorkflowExecution(workflow_id, dag).run(*args, **kwargs)
 
 
 def get_status(workflow_id: str) -> Optional[str]:

@@ -137,3 +137,54 @@ def test_workflow_resume_after_failure(tmp_path):
     assert workflow.resume("wf3") == 50
     assert workflow.get_status("wf3") == "SUCCESSFUL"
     assert counter.read_text() == "a"  # stage_a ran exactly once
+
+
+def test_workflow_resume_replays_input_node_args(tmp_path):
+    """resume() must replay the original run() inputs, not () (ADVICE r3)."""
+    workflow.init(str(tmp_path))
+    flag = tmp_path / "fail"
+    flag.write_text("1")
+
+    @ray_tpu.remote
+    def maybe_fail(x, fail_path):
+        if os.path.exists(fail_path):
+            raise RuntimeError("injected failure")
+        return x + 100
+
+    with InputNode() as inp:
+        dag = maybe_fail.bind(double.bind(inp), str(flag))
+    with pytest.raises(Exception):
+        workflow.run(dag, 21, workflow_id="wf-inp")
+    flag.unlink()
+    # the original arg (21) must survive the resume: 21*2 + 100
+    assert workflow.resume("wf-inp") == 142
+
+
+def test_workflow_actor_method_args_hit_checkpoints(tmp_path):
+    """A function step feeding an actor-method argument must resolve through
+    its checkpoint on re-run, not execute live again (ADVICE r3)."""
+    workflow.init(str(tmp_path))
+    counter = tmp_path / "count"
+
+    @ray_tpu.remote
+    def effectful_parent():
+        with open(counter, "a") as f:
+            f.write("x")
+        return 6
+
+    @ray_tpu.remote
+    class Multiplier:
+        def __init__(self, k):
+            self.k = k
+
+        def mul(self, x):
+            return self.k * x
+
+    actor = Multiplier.bind(7)
+    dag = actor.mul.bind(effectful_parent.bind())
+    assert workflow.run(dag, workflow_id="wf-actor") == 42
+    assert counter.read_text() == "x"
+    # re-run: the actor step re-executes live, but the function parent
+    # must come from its checkpoint (exactly-once side effects)
+    assert workflow.run(dag, workflow_id="wf-actor") == 42
+    assert counter.read_text() == "x"

@@ -74,6 +74,16 @@ def test_repartition_and_shuffle():
     assert sorted(ids) == list(range(50)) and ids != list(range(50))
 
 
+def test_repartition_empty_partitions_keep_schema():
+    # more output blocks than rows: empty partitions must still carry the
+    # schema so downstream column references work (ADVICE r3)
+    ds = rd.range(2).repartition(5)
+    refs = list(ds.iter_internal_refs())
+    assert len(refs) == 5
+    # sort touches the "id" column of every block, including empty ones
+    assert [r["id"] for r in rd.range(2).repartition(5).sort("id").take_all()] == [0, 1]
+
+
 def test_streaming_split():
     ds = rd.range(64).repartition(8)
     its = ds.streaming_split(2)

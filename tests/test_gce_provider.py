@@ -1,0 +1,167 @@
+"""GceTpuProvider state-machine tests with a mocked gcloud CLI.
+
+Covers the delete-retry / missing-poll-grace machinery (reference:
+python/ray/autoscaler/_private/gcp/node_provider.py lifecycle handling):
+pending-delete freeze, 2-poll absence grace, retry backoff, and peer
+termination fast paths.
+"""
+
+import pytest
+
+import ray_tpu.autoscaler.gce as gce_mod
+from ray_tpu.autoscaler.gce import GceTpuProvider
+from ray_tpu.autoscaler.instance_manager import (
+    DRAINING, REQUESTED, RUNNING, STARTING, TERMINATED,
+)
+
+
+class _FakeGce(GceTpuProvider):
+    """GceTpuProvider with _gcloud replaced by an in-memory cloud."""
+
+    def __init__(self):
+        # bypass the gcloud-on-PATH check; set the same fields __init__ would
+        self.project = "p"
+        self.zone = "z"
+        self.gcs_address = "host:1"
+        self.runtime_version = "v"
+        self.startup_script = "s"
+        self._instances = {}
+        self._pending_deletes = {}
+        self._missing_polls = {}
+        self.delete_retry_s = 60.0
+        # fake cloud state
+        self.cloud = {}          # name -> state string
+        self.fail_delete = False
+        self.delete_calls = 0
+
+    def _gcloud(self, *args):
+        verb = args[3]
+        if verb == "list":
+            return [{"name": f"projects/p/locations/z/nodes/{n}", "state": s}
+                    for n, s in self.cloud.items()]
+        if verb == "create":
+            name = args[4]
+            self.cloud[name] = "CREATING"
+            return {}
+        if verb == "delete":
+            self.delete_calls += 1
+            if self.fail_delete:
+                raise RuntimeError("gcloud delete: injected failure")
+            self.cloud.pop(args[4], None)
+            return {}
+        raise AssertionError(f"unexpected gcloud verb {verb}")
+
+
+@pytest.fixture
+def prov():
+    return _FakeGce()
+
+
+def _group(prov, hosts=2):
+    insts = prov.request_group({"accelerator_type": "v5litepod-8",
+                                "hosts": hosts})
+    assert all(i.state == REQUESTED for i in insts)
+    return insts[0].group_id, insts
+
+
+def test_poll_maps_cloud_states(prov):
+    gid, insts = _group(prov)
+    prov.poll()
+    assert all(i.state == STARTING for i in insts)  # CREATING -> STARTING
+    prov.cloud[gid] = "READY"
+    prov.poll()
+    assert all(i.state == RUNNING for i in insts)
+
+
+def test_terminate_removes_all_peers_and_fast_paths(prov):
+    gid, insts = _group(prov)
+    prov.cloud[gid] = "READY"
+    prov.poll()
+    prov.terminate(insts[0])
+    assert all(i.state == TERMINATED for i in insts)
+    assert gid not in prov.cloud
+    calls = prov.delete_calls
+    prov.terminate(insts[1])  # peer already TERMINATED: no gcloud call
+    assert prov.delete_calls == calls
+
+
+def test_failed_delete_enters_pending_and_freezes_state(prov):
+    gid, insts = _group(prov)
+    prov.cloud[gid] = "READY"
+    prov.poll()
+    for i in insts:
+        i.transition(DRAINING)  # what drain_and_terminate_group does
+    prov.fail_delete = True
+    prov.terminate(insts[0])
+    assert gid in prov._pending_deletes
+    assert all(i.state == DRAINING for i in insts)
+    # a still-READY listing must NOT resurrect the drained group to RUNNING
+    prov.poll()
+    assert all(i.state == DRAINING for i in insts)
+    # and the backoff must hold: polling again within the window makes no
+    # further delete attempts
+    calls = prov.delete_calls
+    prov.poll()
+    assert prov.delete_calls == calls
+
+
+def test_pending_delete_retries_after_backoff_and_lands(prov, monkeypatch):
+    gid, insts = _group(prov)
+    prov.cloud[gid] = "READY"
+    prov.poll()
+    prov.fail_delete = True
+    prov.terminate(insts[0])
+    assert gid in prov._pending_deletes
+    # jump past the backoff window; the retry succeeds this time
+    prov.fail_delete = False
+    monkeypatch.setattr(gce_mod.time, "monotonic",
+                        lambda base=gce_mod.time.monotonic(): base + 120.0)
+    prov.poll()
+    assert gid not in prov._pending_deletes
+    assert all(i.state == TERMINATED for i in insts)
+    assert gid not in prov.cloud
+
+
+def test_pending_delete_confirmed_gone_needs_two_absent_polls(prov):
+    gid, insts = _group(prov)
+    prov.cloud[gid] = "READY"
+    prov.poll()
+    prov.fail_delete = True
+    prov.terminate(insts[0])
+    # the VM disappears server-side (the delete actually landed remotely)
+    del prov.cloud[gid]
+    prov.poll()  # first absence: grace — nothing finalized yet
+    assert gid in prov._pending_deletes
+    assert all(i.state != TERMINATED for i in insts)
+    prov.poll()  # second absence: confirmed gone, no doomed delete call
+    calls_before = prov.delete_calls
+    assert gid not in prov._pending_deletes
+    assert all(i.state == TERMINATED for i in insts)
+    assert prov.delete_calls == calls_before
+    assert gid not in prov._missing_polls  # counter cleaned up
+
+
+def test_transient_listing_absence_does_not_kill_live_group(prov):
+    gid, insts = _group(prov)
+    prov.cloud[gid] = "READY"
+    prov.poll()
+    # one transient partial listing: group temporarily absent
+    saved = prov.cloud.pop(gid)
+    prov.poll()
+    assert all(i.state == RUNNING for i in insts)
+    prov.cloud[gid] = saved  # it reappears: counter resets
+    prov.poll()
+    assert prov._missing_polls.get(gid, 0) == 0
+    assert all(i.state == RUNNING for i in insts)
+
+
+def test_externally_deleted_group_terminates_after_grace(prov):
+    gid, insts = _group(prov)
+    prov.cloud[gid] = "READY"
+    prov.poll()
+    del prov.cloud[gid]  # reaped behind our back
+    prov.poll()
+    assert all(i.state == RUNNING for i in insts)  # grace poll 1
+    prov.poll()
+    assert all(i.state == TERMINATED for i in insts)
+    assert gid not in prov._missing_polls
