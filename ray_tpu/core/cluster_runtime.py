@@ -123,8 +123,10 @@ class ClusterRuntime(CoreRuntime):
                 contained=[r.id.hex() for r in refs] or None,
             )
             return ObjectRef(oid)
-        self.agent.call("create_object", object_id=oid.hex(), size=len(payload))
-        writer = ShmWriter(oid, len(payload), self.node_hex)
+        resp = self.agent.call("create_object", object_id=oid.hex(),
+                               size=len(payload))
+        offset = resp.get("offset") if isinstance(resp, dict) else None
+        writer = ShmWriter(oid, len(payload), self.node_hex, offset=offset)
         writer.buffer[:] = payload
         writer.seal()
         self.agent.call(
@@ -133,10 +135,11 @@ class ClusterRuntime(CoreRuntime):
         )
         return ObjectRef(oid)
 
-    def _read_local(self, oid: ObjectID, size: int, is_error: bool) -> Any:
-        reader = ShmReader(oid, size, self.node_hex)
+    def _read_local(self, oid: ObjectID, size: int, is_error: bool,
+                    offset: Optional[int] = None) -> Any:
+        reader = ShmReader(oid, size, self.node_hex, offset=offset)
         try:
-            value = serialization.unpack(bytes(reader.buffer), zero_copy=True)
+            value = serialization.unpack(reader.read_bytes(), zero_copy=True)
         finally:
             reader.close()
         if is_error:
@@ -190,7 +193,23 @@ class ClusterRuntime(CoreRuntime):
                             f"get() timed out waiting for {ref.id.hex()[:16]}"
                         )
                     raise exc.ObjectLostError(ref.id.hex(), info["error"])
-                out.append(self._read_local(ref.id, info["size"], info["is_error"]))
+                for attempt in range(4):
+                    try:
+                        out.append(self._read_local(ref.id, info["size"],
+                                                    info["is_error"],
+                                                    offset=info.get("offset")))
+                        break
+                    except FileNotFoundError:
+                        # arena slot evicted between the metadata reply and
+                        # the copy (or mid-copy): the object may still live
+                        # in spill — re-ensure and retry with fresh metadata
+                        if attempt == 3:
+                            raise exc.ObjectLostError(
+                                ref.id.hex(), "evicted repeatedly during read")
+                        info = self.agent.call(
+                            "ensure_local", object_id=ref.id.hex(),
+                            timeout_s=10.0, timeout=15.0,
+                        )
         finally:
             if blocked:
                 self._notify_blocked(False)

@@ -96,6 +96,13 @@ _DEFINITIONS = [
      "Shared-memory object store arena size per node."),
     ("object_store_full_retries", 10, int,
      "Retries (with eviction attempts) before a put fails with ObjectStoreFullError."),
+    ("arena_abort_quarantine_s", 5.0, float,
+     "Grace period before an aborted arena reservation's block is reused "
+     "(a zombie writer's late bytes must land in dead memory)."),
+    ("object_store_backend", "auto", str,
+     "Object store backend: 'arena' (native C++ allocator over one shm arena), "
+     "'segments' (one shm file per object), or 'auto' (arena when the native "
+     "library builds, else segments)."),
     ("max_direct_call_object_size", 100 * 1024, int,
      "Task returns under this size are sent inline to the owner instead of the shared store."),
     ("object_spilling_enabled", True, bool,

@@ -680,8 +680,8 @@ class NodeAgent:
         rewrite live-readable memory)."""
         oid = ObjectID.from_hex(object_id)
         try:
-            self.store.reserve(oid, size)
-            return {"ok": True, "existing": None}
+            offset = self.store.reserve(oid, size)
+            return {"ok": True, "existing": None, "offset": offset}
         except FileExistsError:
             info = self.store.info(oid)
             sealed = bool(info and info[1])
@@ -689,6 +689,7 @@ class NodeAgent:
                 "ok": True,
                 "existing": "sealed" if sealed else "reserved",
                 "size": info[0] if info else 0,
+                "offset": self.store.offset(oid),
             }
 
     async def rpc_seal_object(self, object_id: str, size: int, owner: str = "",
@@ -767,19 +768,22 @@ class NodeAgent:
                          contained: Optional[List[str]] = None) -> Dict[str, Any]:
         oid = ObjectID.from_hex(object_id)
         try:
-            self.store.reserve(oid, len(payload))
+            offset = self.store.reserve(oid, len(payload))
         except FileExistsError:
             info = self.store.info(oid)
             if info and info[1]:
                 return {"ok": True, "existing": "sealed"}  # idempotent retry
             if info and info[0] != len(payload):
                 self.store.abort(oid)
-                self.store.reserve(oid, len(payload))
+                offset = self.store.reserve(oid, len(payload))
+            else:
+                offset = self.store.offset(oid)  # same-size retried reserve
+
         def _write_segment() -> None:
             # shm create/ftruncate/mmap/copy are synchronous syscalls: run off
             # the event loop so a put flood can't starve heartbeats/RPCs
             try:
-                writer = ShmWriter(oid, len(payload), self.hex)
+                writer = ShmWriter(oid, len(payload), self.hex, offset=offset)
             except FileExistsError:
                 # stale segment from a crashed writer: attach and overwrite
                 from ray_tpu.core.shm_store import ShmSegment, segment_name
@@ -833,16 +837,21 @@ class NodeAgent:
         if info is None:
             return None
         size, sealed = info
-        return {"size": size, "sealed": sealed, "is_error": object_id in self.error_objects}
+        return {"size": size, "sealed": sealed,
+                "is_error": object_id in self.error_objects,
+                "offset": self.store.offset(oid)}
 
     async def rpc_read_chunk(self, object_id: str, offset: int, length: int) -> bytes:
         oid = ObjectID.from_hex(object_id)
         size = self.store.ensure_local(oid)
         if size is None:
             raise KeyError(f"object {object_id[:16]} not on node {self.hex[:8]}")
-        reader = ShmReader(oid, size, self.hex)
+        reader = ShmReader(oid, size, self.hex, offset=self.store.offset(oid))
         try:
-            return bytes(reader.buffer[offset : offset + length])
+            data = bytes(reader.buffer[offset : offset + length])
+            if not reader.revalidate():
+                raise KeyError(f"object {object_id[:16]} evicted mid-read")
+            return data
         finally:
             reader.close()
 
@@ -856,7 +865,8 @@ class NodeAgent:
         async with lock:
             size = self.store.ensure_local(oid)
             if size is not None and self.store.contains(oid):
-                return {"size": size, "is_error": object_id in self.error_objects}
+                return {"size": size, "is_error": object_id in self.error_objects,
+                        "offset": self.store.offset(oid)}
             # remote: resolve location via GCS long-poll (event-driven — the
             # GCS wakes us on register/lost instead of us re-polling lookup)
             while True:
@@ -875,7 +885,9 @@ class NodeAgent:
                     rec = None
                 if rec and rec["locations"]:
                     if self.hex in rec["locations"] and self.store.contains(oid):
-                        return {"size": rec["size"], "is_error": object_id in self.error_objects}
+                        return {"size": rec["size"],
+                                "is_error": object_id in self.error_objects,
+                                "offset": self.store.offset(oid)}
                     remotes = [n for n in rec["locations"] if n != self.hex]
                     if remotes:
                         ok = await self._pull(oid, rec["size"], remotes)
@@ -885,6 +897,7 @@ class NodeAgent:
                             return {
                                 "size": rec["size"],
                                 "is_error": object_id in self.error_objects,
+                                "offset": self.store.offset(oid),
                             }
                         # pull failed (e.g. the only location just crashed and
                         # the GCS hasn't reaped it yet): the long-poll returns
@@ -1019,8 +1032,9 @@ class NodeAgent:
                 client = await self._peer(node_id)
                 if client is None:
                     continue
-                self.store.reserve(oid, size)
-                writer = ShmWriter(oid, size, self.hex)
+                arena_off = self.store.reserve(oid, size)
+                writer = ShmWriter(oid, size, self.hex, offset=arena_off)
+                seal_failed = False
                 try:
                     offset = 0
                     chunk = config.fetch_chunk_bytes
@@ -1032,7 +1046,15 @@ class NodeAgent:
                         writer.buffer[offset : offset + len(data)] = data
                         offset += len(data)
                 finally:
-                    writer.seal()
+                    try:
+                        writer.seal()
+                    except FileNotFoundError:
+                        # reservation aborted while pulling: don't let the
+                        # seal error mask the chunk error / skip cleanup
+                        seal_failed = True
+                if seal_failed:
+                    raise KeyError(
+                        f"reservation for {object_id[:16]} aborted mid-pull")
                 self.store.seal(oid)
                 # peer knows error-ness
                 info = await client.call("object_info", object_id=object_id)
@@ -1707,7 +1729,7 @@ class NodeAgent:
         deadline = time.monotonic() + 30.0
         while True:
             try:
-                self.store.reserve(oid, len(payload))
+                offset = self.store.reserve(oid, len(payload))
                 break
             except ObjectStoreFullError:
                 # error objects are what UNBLOCK waiters — losing one turns a
@@ -1716,7 +1738,7 @@ class NodeAgent:
                 if time.monotonic() > deadline:
                     raise
                 await asyncio.sleep(0.1)
-        writer = ShmWriter(oid, len(payload), self.hex)
+        writer = ShmWriter(oid, len(payload), self.hex, offset=offset)
         writer.buffer[:] = payload
         writer.seal()
         self.store.seal(oid)

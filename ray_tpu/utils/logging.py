@@ -63,4 +63,14 @@ def setup_component_logging(
         sh.setFormatter(formatter)
         root.addHandler(sh)
     root.propagate = False
+    # `kill -USR1 <pid>` dumps every thread's stack to stderr (which the
+    # supervisor redirects into the session log) — the `ray stack` analogue
+    # (reference: scripts `ray stack` / python/ray/util/rpdb.py)
+    try:
+        import faulthandler
+        import signal
+
+        faulthandler.register(signal.SIGUSR1, all_threads=True, chain=True)
+    except (ImportError, ValueError, AttributeError):
+        pass  # non-main thread / unsupported platform
     return root
