@@ -867,7 +867,15 @@ class LocalRuntime(CoreRuntime):
         w.set_task_context(spec.task_id, actor.actor_id, spec.name)
         start = time.monotonic()
         try:
-            method = getattr(actor.instance, call.func_name)
+            if call.func_name == "__rtpu_channel_loop__":
+                # compiled-DAG stage loop hook (ray_tpu/dag/compiled.py)
+                import functools as _functools
+
+                from ray_tpu.dag.compiled import channel_loop
+
+                method = _functools.partial(channel_loop, actor.instance)
+            else:
+                method = getattr(actor.instance, call.func_name)
             result = method(*r_args, **r_kwargs)
             if spec.generator:
                 self._drive_generator(spec, result)

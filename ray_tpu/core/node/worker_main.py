@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import functools
 import hashlib
 import os
 import queue
@@ -28,7 +29,7 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.rpc import RpcClient, RpcServer, SyncRpcClient, spawn
-from ray_tpu.core.shm_store import ShmReader, ShmWriter
+from ray_tpu.core.shm_store import ShmWriter
 from ray_tpu.utils.logging import get_logger, setup_component_logging
 
 logger = get_logger("worker")
@@ -110,14 +111,6 @@ class WorkerProcess:
             self._fn_cache[function_id] = fn
         return fn
 
-    def _read_object(self, object_id: str, size: int) -> Any:
-        reader = ShmReader(ObjectID.from_hex(object_id), size, self.node_hex)
-        try:
-            # copy-then-unpack: the segment may be evicted once we release
-            return serialization.unpack(bytes(reader.buffer), zero_copy=True)
-        finally:
-            reader.close()
-
     def _resolve_args(self, payload: bytes) -> tuple:
         """Unpack (args, kwargs); resolve TOP-LEVEL ObjectRefs to values
         (nested refs stay refs — reference semantics)."""
@@ -175,11 +168,12 @@ class WorkerProcess:
             asyncio.run_coroutine_threadsafe(
                 self.agent.call("abort_object", object_id=object_id), self._loop
             ).result()
-            asyncio.run_coroutine_threadsafe(
+            resp = asyncio.run_coroutine_threadsafe(
                 self.agent.call("create_object", object_id=object_id, size=len(payload)),
                 self._loop,
             ).result()
-        writer = ShmWriter(oid, len(payload), self.node_hex)
+        offset = resp.get("offset") if isinstance(resp, dict) else None
+        writer = ShmWriter(oid, len(payload), self.node_hex, offset=offset)
         writer.buffer[:] = payload
         writer.seal()
         asyncio.run_coroutine_threadsafe(
@@ -430,7 +424,15 @@ class WorkerProcess:
             task_id, ActorID.from_hex(spec["actor_id"]), spec.get("name", "")
         )
         try:
-            method = getattr(self.actor_instance, spec["method"])
+            if spec["method"] == "__rtpu_channel_loop__":
+                # compiled-DAG stage loop (ray_tpu/dag/compiled.py): a
+                # framework-injected long-running method that takes over
+                # this actor until its channels close
+                from ray_tpu.dag.compiled import channel_loop
+
+                method = functools.partial(channel_loop, self.actor_instance)
+            else:
+                method = getattr(self.actor_instance, spec["method"])
             args, kwargs = self._resolve_args(spec["args_payload"])
             result = method(*args, **kwargs)
             if asyncio.iscoroutine(result):

@@ -39,6 +39,16 @@ class DAGNode:
     def _execute_impl(self, ctx: "_ExecutionContext"):
         raise NotImplementedError
 
+    def experimental_compile(self, max_buffer_bytes: int = 8 << 20,
+                             timeout_s: float = 3600.0):
+        """Compile this DAG into channel-wired persistent actor loops
+        (reference: compiled_dag_node.py:664). Steady-state execution does
+        zero control-plane RPCs per call."""
+        from ray_tpu.dag.compiled import experimental_compile
+
+        return experimental_compile(self, max_buffer_bytes=max_buffer_bytes,
+                                    timeout_s=timeout_s)
+
     # graph introspection (reference: DAGNode._get_all_child_nodes)
     def _children(self) -> List["DAGNode"]:
         return []

@@ -1,0 +1,171 @@
+"""Compiled DAG (aDAG analogue) + channel tests.
+
+Reference capability: python/ray/dag/tests/experimental/test_accelerated_dag.py
+— execute() through pre-provisioned actor loops over mutable channels.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channel import Channel, ChannelClosed, ChannelError
+
+
+@pytest.fixture(autouse=True)
+def _init(ray_tpu_local):
+    yield
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, inc):
+        self.inc = inc
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.inc
+
+    def get_calls(self):
+        return self.calls
+
+
+@ray_tpu.remote
+class Doubler:
+    def mul(self, x):
+        return 2 * x
+
+    def combine(self, a, b):
+        return a + b
+
+
+def test_channel_basic_roundtrip():
+    ch = Channel.create(capacity=1 << 16, num_readers=1)
+    r = Channel.open(ch.handle, reader_slot=0)
+    assert ch.write({"k": [1, 2, 3]}) == 1
+    assert r.read() == {"k": [1, 2, 3]}
+    ch.close()
+    with pytest.raises(ChannelClosed):
+        r.read()
+    ch.destroy()
+
+
+def test_channel_backpressure_depth1():
+    ch = Channel.create(capacity=1 << 16, num_readers=1)
+    r = Channel.open(ch.handle, reader_slot=0)
+    ch.write("a")
+    # second write must block until the reader acks version 1
+    with pytest.raises(Exception):  # ChannelTimeout
+        ch.write("b", timeout_s=0.2)
+    assert r.read() == "a"
+    assert ch.write("b", timeout_s=5.0) == 2
+    assert r.read() == "b"
+    ch.destroy()
+
+
+def test_channel_rejects_oversized_payload():
+    ch = Channel.create(capacity=1024, num_readers=1)
+    with pytest.raises(ChannelError):
+        ch.write(b"x" * 4096)
+    ch.destroy()
+
+
+def test_compiled_linear_chain():
+    with InputNode() as inp:
+        dag = Adder.bind(10).add.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(1).get(timeout=30) == 11
+        assert compiled.execute(2).get(timeout=30) == 12
+        # pipelined: submit several before reading
+        refs = [compiled.execute(i) for i in [5, 6]]
+        assert [r.get(timeout=30) for r in refs] == [15, 16]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_two_stage_pipeline():
+    with InputNode() as inp:
+        mid = Adder.bind(1).add.bind(inp)
+        dag = Doubler.bind().mul.bind(mid)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(3).get(timeout=30) == 8  # (3+1)*2
+        assert compiled.execute(10).get(timeout=30) == 22
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_fan_out_multi_output():
+    with InputNode() as inp:
+        a = Adder.bind(100).add.bind(inp)
+        b = Adder.bind(200).add.bind(inp)
+        dag = MultiOutputNode([a, b])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(5).get(timeout=30) == [105, 205]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_diamond():
+    with InputNode() as inp:
+        a = Adder.bind(1).add.bind(inp)
+        b = Adder.bind(2).add.bind(inp)
+        dag = Doubler.bind().combine.bind(a, b)
+    compiled = dag.experimental_compile()
+    try:
+        # (x+1) + (x+2)
+        assert compiled.execute(10).get(timeout=30) == 23
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_stage_error_propagates():
+    @ray_tpu.remote
+    class Bad:
+        def boom(self, x):
+            raise ValueError(f"bad input {x}")
+
+    with InputNode() as inp:
+        dag = Bad.bind().boom.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(RuntimeError, match="bad input 7"):
+            compiled.execute(7).get(timeout=30)
+        # the DAG survives an error and keeps serving
+        with pytest.raises(RuntimeError, match="bad input 8"):
+            compiled.execute(8).get(timeout=30)
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_rejects_function_nodes():
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        dag = f.bind(inp)
+    with pytest.raises(ChannelError):
+        dag.experimental_compile()
+
+
+def test_compiled_requires_input_node():
+    dag = Adder.bind(1).add.bind(41)
+    with pytest.raises(ChannelError):
+        dag.experimental_compile()
+
+
+def test_teardown_frees_actor_for_normal_calls():
+    with InputNode() as inp:
+        actor = Adder.bind(10)
+        dag = actor.add.bind(inp)
+    compiled = dag.experimental_compile()
+    handle = compiled._actors[id(actor)]
+    assert compiled.execute(1).get(timeout=30) == 11
+    compiled.teardown()
+    # loop exited: the actor serves regular calls again
+    assert ray_tpu.get(handle.get_calls.remote(), timeout=30) >= 1
