@@ -37,20 +37,44 @@ class MeshConfig:
     cp: int = 1
     ep: int = 1
     pp: int = 1
+    # ---- multi-slice (DCN) factors --------------------------------------
+    # A multi-slice job is N identical ICI slices joined by data-center
+    # network. DCN factors multiply INTO the same logical axes (dp/pp), so
+    # PartitionSpecs are unchanged and XLA's hierarchical collectives do
+    # ring-reduce inside each slice over ICI and one cross-slice hop over
+    # DCN (the "How to Scale Your Model" multislice recipe; the reference
+    # has no multi-slice story — its NCCL groups are flat).
+    dcn_dp: int = 1   # data-parallel replicas across slices (the default)
+    dcn_pp: int = 1   # pipeline stages across slices (for weight-bound models)
 
     def axis_sizes(self) -> Dict[str, int]:
+        """LOGICAL axis sizes (dcn factors folded into pp/dp)."""
+        return {"pp": self.pp * self.dcn_pp, "dp": self.dp * self.dcn_dp,
+                "fsdp": self.fsdp, "ep": self.ep, "cp": self.cp, "tp": self.tp}
+
+    def slice_axis_sizes(self) -> Dict[str, int]:
+        """Per-slice (ICI) axis sizes."""
         return {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
                 "ep": self.ep, "cp": self.cp, "tp": self.tp}
 
     @property
-    def num_devices(self) -> int:
+    def num_slices(self) -> int:
+        return self.dcn_dp * self.dcn_pp
+
+    @property
+    def devices_per_slice(self) -> int:
         return self.pp * self.dp * self.fsdp * self.ep * self.cp * self.tp
+
+    @property
+    def num_devices(self) -> int:
+        return self.devices_per_slice * self.num_slices
 
     def validate(self, available: int) -> None:
         if self.num_devices != available:
             raise ValueError(
                 f"MeshConfig uses {self.num_devices} devices "
-                f"({self.axis_sizes()}), but {available} are available"
+                f"({self.axis_sizes()}, {self.num_slices} slice(s)), "
+                f"but {available} are available"
             )
 
     @classmethod
@@ -92,6 +116,9 @@ def make_mesh(
     names_sizes = mesh_shape_for(config)
     names = tuple(n for n, _ in names_sizes)
     shape = tuple(s for _, s in names_sizes)
+    if config.num_slices > 1:
+        return jax.sharding.Mesh(
+            _hybrid_mesh_array(config, devs, allow_split_physical_axes), names)
     try:
         from jax.experimental import mesh_utils
 
@@ -101,6 +128,52 @@ def make_mesh(
     except Exception:
         arr = np.asarray(devs).reshape(shape)
     return jax.sharding.Mesh(arr, names)
+
+
+def _hybrid_mesh_array(config: MeshConfig, devs,
+                       allow_split_physical_axes: bool = True):
+    """Device array for a multi-slice mesh: DCN factors take the OUTER
+    position of their logical axis, so index = slice_part * ici_size +
+    ici_part and collectives decompose hierarchically (ICI ring inside each
+    slice, one DCN hop across). Uses jax's hybrid mesh when the devices
+    carry real slice_index metadata; otherwise groups devices contiguously
+    into virtual slices (CPU-mesh testing)."""
+    import numpy as np
+
+    per = config.slice_axis_sizes()
+    ici_shape = tuple(per[n] for n in AXIS_ORDER)
+    dcn_shape = tuple(
+        {"pp": config.dcn_pp, "dp": config.dcn_dp}.get(n, 1) for n in AXIS_ORDER
+    )
+    slice_ids = {getattr(d, "slice_index", None) for d in devs}
+    if None not in slice_ids and len(slice_ids) > 1:
+        # real multi-slice hardware: the config MUST match the physical
+        # topology — grouping devices from different physical slices into
+        # one "virtual slice" would silently run ICI collectives over DCN
+        if len(slice_ids) != config.num_slices:
+            raise ValueError(
+                f"devices span {len(slice_ids)} physical slices but the "
+                f"MeshConfig declares num_slices={config.num_slices} "
+                f"(dcn_dp={config.dcn_dp}, dcn_pp={config.dcn_pp})"
+            )
+        from jax.experimental import mesh_utils
+
+        try:
+            return mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devs,
+                allow_split_physical_axes=allow_split_physical_axes)
+        except TypeError:  # older jax without the kwarg
+            return mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devs)
+    # virtual slices: contiguous groups (process/device order is already
+    # ICI-major under xla_force_host_platform_device_count)
+    arr = np.asarray(devs).reshape(
+        (config.dcn_pp, config.dcn_dp) + ici_shape)
+    # (dcn_pp, dcn_dp, pp, dp, fsdp, ep, cp, tp)
+    #   -> (dcn_pp, pp, dcn_dp, dp, fsdp, ep, cp, tp) -> merge dcn into axes
+    arr = arr.transpose(0, 2, 1, 3, 4, 5, 6, 7)
+    logical = config.axis_sizes()
+    return arr.reshape(tuple(logical[n] for n in AXIS_ORDER))
 
 
 def ici_topology_labels(device) -> Dict[str, str]:

@@ -118,3 +118,73 @@ def test_moe_grad_flows():
     g = jax.grad(loss)(params)
     total = sum(float(jnp.abs(v).sum()) for v in jax.tree.leaves(g))
     assert np.isfinite(total) and total > 0
+
+
+class TestMultiSlice:
+    """Multi-slice (DCN) meshes: dcn factors fold into logical dp/pp with
+    slice-major device placement (SURVEY §5 'megascale'; the sharding-book
+    multislice recipe — ICI ring per slice, one DCN hop across)."""
+
+    def test_hybrid_mesh_shape_and_slice_major_order(self):
+        mc = MeshConfig(dcn_dp=2, fsdp=2, tp=2)
+        assert mc.num_slices == 2 and mc.devices_per_slice == 4
+        assert mc.axis_sizes()["dp"] == 2
+        mesh = make_mesh(mc, devices=jax.devices()[:8])
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "cp": 1, "tp": 2}
+        # slice-major: dp index 0 holds devices 0-3, dp index 1 holds 4-7
+        dp_axis = mesh.axis_names.index("dp")
+        arr = np.moveaxis(mesh.devices, dp_axis, 0).reshape(2, -1)
+        assert {d.id for d in arr[0]} == {0, 1, 2, 3}
+        assert {d.id for d in arr[1]} == {4, 5, 6, 7}
+
+    def test_dcn_pp_outer_stages(self):
+        mc = MeshConfig(dcn_pp=2, pp=1, fsdp=4)
+        mesh = make_mesh(mc, devices=jax.devices()[:8])
+        assert dict(zip(mesh.axis_names, mesh.devices.shape))["pp"] == 2
+
+    def test_psum_over_dcn_dp_axis(self):
+        """A data-parallel gradient reduction spanning slices compiles and
+        produces the correct cross-slice sum."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        mc = MeshConfig(dcn_dp=2, fsdp=2, tp=2)
+        mesh = make_mesh(mc, devices=jax.devices()[:8])
+        x = jnp.arange(8.0).reshape(8, 1)
+
+        @jax.jit
+        def allreduce(x):
+            def f(xs):
+                return jax.lax.psum(xs, axis_name=("dp", "fsdp"))
+
+            return shard_map(f, mesh=mesh, in_specs=P(("dp", "fsdp")),
+                             out_specs=P())(x)
+
+        out = allreduce(jax.device_put(
+            x, NamedSharding(mesh, P(("dp", "fsdp")))))
+        # 4 shards of 2 rows; elementwise sum across shards: rows {0,2,4,6}
+        # and {1,3,5,7}
+        np.testing.assert_allclose(np.asarray(out), [[12.0], [16.0]])
+
+    def test_train_step_on_two_virtual_slices(self):
+        """Full train step (fwd+bwd+opt) on a dcn_dp=2 x (fsdp=2, tp=2)
+        mesh — the multislice flagship path the dryrun also exercises."""
+        from ray_tpu.models.llama import LlamaConfig
+        from ray_tpu.train.step import (
+            default_optimizer, make_train_state_factory, make_train_step,
+        )
+
+        mc = MeshConfig(dcn_dp=2, fsdp=2, tp=2)
+        mesh = make_mesh(mc, devices=jax.devices()[:8])
+        config = LlamaConfig.tiny(dtype=jnp.float32, remat=None,
+                                  attention_impl="reference")
+        opt = default_optimizer(warmup_steps=1, total_steps=10)
+        init = make_train_state_factory(config, opt, mesh=mesh)
+        step = make_train_step(config, opt, mesh=mesh)
+        state = init(jax.random.key(0))
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(
+            rng.integers(0, config.vocab_size, (8, 64)), jnp.int32)
+        state, metrics = step(state, tokens, jnp.roll(tokens, -1, axis=1))
+        assert np.isfinite(float(metrics["loss"]))
