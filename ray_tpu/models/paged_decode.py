@@ -1,0 +1,270 @@
+"""Paged KV cache + paged decode for the serving engine.
+
+Reference capability: the reference serves LLMs through vLLM's PagedAttention
+(external engine); here paging is first-class and TPU-native. The KV cache
+is a PAGE POOL [L, n_kv, total_pages, page_size, D]; each slot owns a list
+of pages recorded in a device block table [num_slots, max_pages_per_slot].
+HBM is committed per-request (ceil((prompt+max_tokens)/page_size) pages),
+not per-slot*max_seq — so slot count is bounded by real demand, and mixed
+short/long workloads pack 3-8x more concurrent requests into the same HBM
+than the dense slotted cache (models/decode.py).
+
+Decode attention runs the TPU Pallas paged_attention kernel
+(jax.experimental.pallas.ops.tpu.paged_attention) — block-sparse reads of
+exactly the pages a slot owns, no gather materialization. Off-TPU (CPU
+tests) a reference gather path computes the same thing.
+
+Layout notes:
+- page_size is a multiple of 8 (TPU sublane) and prefill buckets are
+  multiples of page_size so prompt K/V scatter is a clean reshape-scatter.
+- the pool rides layer-scan carries DONATED through jit, like decode.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.decode import _lm_head, _mlp, _project_qkv, sample_token
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+class PagedKVCache(NamedTuple):
+    k: jax.Array  # [L, n_kv, total_pages, page_size, D]
+    v: jax.Array  # [L, n_kv, total_pages, page_size, D]
+
+
+def init_paged_cache(config: LlamaConfig, total_pages: int, page_size: int,
+                     dtype=jnp.bfloat16) -> PagedKVCache:
+    shape = (config.num_layers, config.num_kv_heads, total_pages, page_size,
+             config.head_dim_)
+    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _scatter_token_rows(pool, rows, pages, rownum):
+    """pool: [n_kv, P_total, ps, D]; rows: [B, n_kv, D]; pages/rownum: [B].
+    One decoded token per slot -> scatter into (page, row). Measured on
+    v5e: the extract-layer/scatter/writeback pattern XLA fuses in place is
+    ~25% faster per decode chunk than a batched-layer-index advanced
+    scatter into the full [L, ...] cache."""
+    vals = rows.transpose(1, 0, 2)  # [n_kv, B, D]
+    return pool.at[:, pages, rownum].set(vals.astype(pool.dtype))
+
+
+def _paged_attention_reference(q, k_pool, v_pool, table, lengths, scale):
+    """Gather-based paged attention (CPU tests / non-TPU fallback).
+    q: [B, nh, D]; pools: [n_kv, P_total, ps, D]; table: [B, max_pages];
+    lengths: [B] (inclusive count of valid rows)."""
+    b, nh, d = q.shape
+    nkv, _, ps, _ = k_pool.shape
+    max_pages = table.shape[1]
+    # gather each slot's pages -> [B, n_kv, max_pages*ps, D]
+    kg = k_pool[:, table]            # [n_kv, B, max_pages, ps, D]
+    vg = v_pool[:, table]
+    kg = kg.transpose(1, 0, 2, 3, 4).reshape(b, nkv, max_pages * ps, d)
+    vg = vg.transpose(1, 0, 2, 3, 4).reshape(b, nkv, max_pages * ps, d)
+    rep = nh // nkv
+    qg = q.reshape(b, nkv, rep, d)
+    logits = jnp.einsum("bnrd,bnsd->bnrs", qg, kg,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(max_pages * ps)[None, :] < lengths[:, None]  # [B, S]
+    logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnrs,bnsd->bnrd", probs.astype(vg.dtype), vg,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, nh, d).astype(q.dtype)
+
+
+def _paged_attention(q, k_pool, v_pool, table, lengths, scale, config,
+                     pages_per_block: int = 4):
+    """q: [B, 1, nh, D] -> [B, 1, nh, D]."""
+    qs = (q[:, 0] * scale).astype(q.dtype)  # kernel does NOT scale q
+    # the Pallas kernel tiles head_dim onto the 128-lane register file; for
+    # other head dims (tiny test configs) the gather path computes the same
+    if jax.default_backend() == "tpu" and q.shape[-1] % 128 == 0:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention,
+        )
+
+        out = paged_attention(
+            qs.astype(jnp.float32), k_pool, v_pool,
+            lengths.astype(jnp.int32), table.astype(jnp.int32),
+            pages_per_compute_block=min(pages_per_block, table.shape[1]),
+        )
+        return out[:, None].astype(q.dtype)
+    out = _paged_attention_reference(qs, k_pool, v_pool, table, lengths, 1.0)
+    return out[:, None]
+
+
+# --------------------------------------------------------------------------- #
+# Prefill
+# --------------------------------------------------------------------------- #
+def _scatter_prompt_rows_full(cache_full, rows, layer, pages, page_size):
+    """cache_full: [L, n_kv, P_total, ps, D]; rows: [PB, S, n_kv, D]
+    (S = NP*ps); pages: [PB, NP]. Scatters every prompt's K/V pages
+    directly into the full cache (one advanced-index scatter per layer)."""
+    pb, s, nkv, d = rows.shape
+    np_ = s // page_size
+    vals = rows.reshape(pb * np_, page_size, nkv, d).transpose(0, 2, 1, 3)
+    li = jnp.full((pb * np_,), layer, jnp.int32)
+    return cache_full.at[li, :, pages.reshape(-1)].set(
+        vals.astype(cache_full.dtype))
+
+
+def paged_prefill(params, cache: PagedKVCache, tokens, pages, lengths,
+                  config: LlamaConfig, page_size: int) -> Tuple[jax.Array, PagedKVCache]:
+    """BATCHED prefill: tokens [PB, S_bucket] (padded, S_bucket %
+    page_size == 0); pages [PB, S_bucket // page_size] page ids per prompt;
+    lengths [PB] true prompt lengths. Returns (last-token logits [PB, V],
+    cache). Batching prompts of the same bucket into one program is what
+    keeps admission off the serving critical path — 64 slots admit in ~8
+    programs instead of 64 (the reference's analogue is vLLM's batched
+    prefill scheduling)."""
+    from ray_tpu.ops.attention import attention
+
+    _, s = tokens.shape
+    cos, sin = rope_frequencies(config.head_dim_, s, config.rope_theta)
+    x = params["embed_tokens"][tokens].astype(config.dtype)
+
+    def body(carry, lp):
+        x, ck_full, cv_full, layer = carry
+        _, q, k, v = _project_qkv(config, lp, x)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        o = attention(q, k, v, causal=True, impl=config.attention_impl)
+        b, t, nh, hd = q.shape
+        x = x + o.reshape(b, t, nh * hd) @ lp["wo"]
+        x = x + _mlp(config, lp, x)
+        ck_full = _scatter_prompt_rows_full(ck_full, k, layer, pages,
+                                            page_size)
+        cv_full = _scatter_prompt_rows_full(cv_full, v, layer, pages,
+                                            page_size)
+        return (x, ck_full, cv_full, layer + 1), None
+
+    (x, new_k, new_v, _), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v, jnp.int32(0)), params["layers"]
+    )
+    logits = _lm_head(params, x, config)  # [PB, S, V]
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [PB, V]
+    return last, PagedKVCache(k=new_k, v=new_v)
+
+
+# --------------------------------------------------------------------------- #
+# Decode
+# --------------------------------------------------------------------------- #
+def paged_decode_one(params, cache: PagedKVCache, tokens, positions, table,
+                     config: LlamaConfig, page_size: int) -> Tuple[jax.Array, PagedKVCache]:
+    """One decode tick. tokens/positions: [B]; table: [B, max_pages].
+    positions[b] = cache index the current token writes to; attention spans
+    [0, positions[b]] inclusive."""
+    scale = config.head_dim_ ** -0.5
+    max_ctx = table.shape[1] * page_size
+    cos, sin = rope_frequencies(config.head_dim_, max_ctx, config.rope_theta)
+    x = params["embed_tokens"][tokens[:, None]].astype(config.dtype)  # [B,1,H]
+    # clamp: a slot finishing mid-chunk keeps ticking to the chunk end (the
+    # host truncates its output later); its position may overrun the table —
+    # pin it to the last row like dynamic_update_slice does in the dense path
+    safe_pos = jnp.minimum(positions, max_ctx - 1)
+    pages = jnp.take_along_axis(
+        table, (safe_pos // page_size)[:, None], axis=1)[:, 0]  # [B]
+    rows = safe_pos % page_size
+    lengths = safe_pos + 1
+
+    def body(carry, lp):
+        x, ck, cv, layer = carry
+        _, q, k, v = _project_qkv(config, lp, x)
+        q = apply_rope(q, cos, sin, positions=positions[:, None])
+        k = apply_rope(k, cos, sin, positions=positions[:, None])
+        ck_layer = _scatter_token_rows(
+            jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False),
+            k[:, 0], pages, rows)
+        cv_layer = _scatter_token_rows(
+            jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False),
+            v[:, 0], pages, rows)
+        ck = jax.lax.dynamic_update_index_in_dim(ck, ck_layer, layer, 0)
+        cv = jax.lax.dynamic_update_index_in_dim(cv, cv_layer, layer, 0)
+        o = _paged_attention(q, ck_layer, cv_layer, table, lengths, scale,
+                             config)
+        b, t, nh, hd = q.shape
+        x = x + o.reshape(b, t, nh * hd) @ lp["wo"]
+        x = x + _mlp(config, lp, x)
+        return (x, ck, cv, layer + 1), None
+
+    (x, new_k, new_v, _), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v, jnp.int32(0)), params["layers"]
+    )
+    logits = _lm_head(params, x, config)[:, 0]  # [B, V]
+    return logits, PagedKVCache(k=new_k, v=new_v)
+
+
+def paged_decode_steps(params, cache: PagedKVCache, tokens, positions, active,
+                       table, key, config: LlamaConfig, num_steps: int,
+                       page_size: int, temperature: float = 0.0):
+    """T decode ticks on device (like decode.decode_steps, paged). The host
+    pre-provisions table pages covering positions+T before each chunk."""
+
+    def tick(carry, k_):
+        toks, pos, cache = carry
+        logits, cache = paged_decode_one(params, cache, toks, pos, table,
+                                         config, page_size)
+        nxt = sample_token(logits, k_, temperature)
+        nxt = jnp.where(active, nxt, toks)
+        new_pos = jnp.where(active, pos + 1, pos)
+        return (nxt, new_pos, cache), nxt
+
+    keys = jax.random.split(key, num_steps)
+    (last, pos, cache), sampled = jax.lax.scan(
+        tick, (tokens, positions, cache), keys
+    )
+    return sampled.T, last, pos, cache
+
+
+def make_paged_decode_fn(config: LlamaConfig, num_steps: int, page_size: int,
+                         temperature: float = 0.0):
+    fn = functools.partial(paged_decode_steps, config=config,
+                           num_steps=num_steps, page_size=page_size,
+                           temperature=temperature)
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+def make_paged_prefill_fn(config: LlamaConfig, page_size: int):
+    fn = functools.partial(paged_prefill, config=config, page_size=page_size)
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+class PageAllocator:
+    """Host-side free-list of KV pages (the vLLM block-manager analogue).
+    Worst-case commitment at admission: a request takes
+    ceil((prompt+max_tokens)/page_size) pages up front, so decode can never
+    hit an out-of-pages condition mid-flight.
+
+    PAGE 0 IS THE TRASH PAGE and is never handed out: inactive slots keep
+    block-table rows of zeros, so their frozen-position writes inside the
+    compiled decode loop land in page 0 instead of stomping a live slot's
+    pages (the paged analogue of the dense cache's per-slot frozen row)."""
+
+    TRASH_PAGE = 0
+
+    def __init__(self, total_pages: int):
+        self.total = total_pages
+        self._free = list(range(total_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[list]:
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def release(self, pages) -> None:
+        for p in pages:
+            assert p != self.TRASH_PAGE
+        self._free.extend(pages)

@@ -52,11 +52,21 @@ class GenRequest:
 
 
 class LLMEngine:
-    """Continuous-batching loop around models/decode.py."""
+    """Continuous-batching loop around models/decode.py (dense slots) or
+    models/paged_decode.py (paged KV cache).
+
+    Paged mode (default): HBM is committed per REQUEST
+    (ceil((prompt+max_tokens)/page_size) pages from a shared pool), not
+    per-slot*max_seq — so ``num_slots`` can far exceed what a dense cache
+    would fit, and short requests stop paying for max_seq rows. Decode
+    attention runs the TPU Pallas paged_attention kernel when head_dim
+    tiles the lane register file (128), else a gather fallback."""
 
     def __init__(self, config, params=None, *, num_slots: int = 8,
                  max_seq_len: Optional[int] = None, decode_chunk: int = 8,
-                 temperature: float = 0.0, prefill_buckets: Optional[List[int]] = None):
+                 temperature: float = 0.0, prefill_buckets: Optional[List[int]] = None,
+                 paged: bool = True, page_size: int = 64,
+                 total_pages: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -74,12 +84,43 @@ class LLMEngine:
         self.params = params if params is not None else llama_init(
             config, jax.random.key(0)
         )
-        self.cache = init_kv_cache(config, num_slots, self.max_seq)
+        self.paged = paged
+        if paged:
+            from ray_tpu.models.paged_decode import (
+                PageAllocator,
+                init_paged_cache,
+                make_paged_decode_fn,
+                make_paged_prefill_fn,
+            )
+
+            self.page_size = page_size
+            self.pages_per_slot = -(-self.max_seq // page_size)
+            # default pool: dense-equivalent capacity (+1 trash page) — same
+            # worst-case guarantees as the slotted cache. The paging WIN is
+            # opting into a smaller pool (or more slots at the same pool):
+            # HBM then tracks real demand instead of slots * max_seq
+            self.total_pages = total_pages or (
+                1 + num_slots * self.pages_per_slot)
+            self.allocator = PageAllocator(self.total_pages)
+            self.cache = init_paged_cache(config, self.total_pages, page_size)
+            self._table = jnp.zeros((num_slots, self.pages_per_slot), jnp.int32)
+            self._slot_pages: List[Optional[List[int]]] = [None] * num_slots
+            self._prefill = make_paged_prefill_fn(config, page_size)
+            self._decode = make_paged_decode_fn(config, decode_chunk,
+                                                page_size, temperature)
+        else:
+            self.cache = init_kv_cache(config, num_slots, self.max_seq)
+            self._prefill = make_prefill_fn(config)
+            self._decode = make_decode_fn(config, decode_chunk, temperature)
         self.prefill_buckets = sorted({
             min(b, self.max_seq) for b in (prefill_buckets or [128, 512, 2048])
         })
-        self._prefill = make_prefill_fn(config)
-        self._decode = make_decode_fn(config, decode_chunk, temperature)
+        if paged:
+            # buckets must be page multiples so prompt K/V scatter is a
+            # clean reshape-scatter
+            self.prefill_buckets = sorted({
+                -(-b // page_size) * page_size for b in self.prefill_buckets
+            })
         self._key = jax.random.key(0)
         # device-side batch state
         self._tokens = jnp.zeros((num_slots,), jnp.int32)
@@ -88,6 +129,10 @@ class LLMEngine:
         # host-side state
         self._slots: List[Optional[GenRequest]] = [None] * num_slots
         self._pending: "queue.Queue[GenRequest]" = queue.Queue()
+        from collections import deque
+
+        # head-of-line holding area for requests the page pool couldn't fit
+        self._admit_backlog: "deque[GenRequest]" = deque()
         self._shutdown = False
         self._jnp = jnp
         self._jax = jax
@@ -148,7 +193,7 @@ class LLMEngine:
         return {
             "slots": self.num_slots,
             "active": sum(r is not None for r in self._slots),
-            "queued": self._pending.qsize(),
+            "queued": self._pending.qsize() + len(self._admit_backlog),
             "decode_steps": self._steps,
             "tokens_generated": self._tokens_out,
             "uptime_s": time.perf_counter() - self._started,
@@ -168,13 +213,20 @@ class LLMEngine:
         # longer than the largest configured bucket: round up to a 128
         # multiple (one extra compile) rather than silently truncating the
         # prompt — max_seq admission already guaranteed it fits
-        return min(self.max_seq, -(-n // 128) * 128)
+        bucket = min(self.max_seq, -(-n // 128) * 128)
+        if self.paged:
+            bucket = -(-bucket // self.page_size) * self.page_size
+            bucket = min(bucket, self.pages_per_slot * self.page_size)
+        return bucket
 
     def _admit(self) -> None:
         """Prefill waiting requests into free slots WITHOUT a host sync: the
         first sampled token stays on device and is fetched together with the
         next decode chunk (one round trip per loop iteration — dispatch
         latency over tunneled TPUs would otherwise serialize admissions)."""
+        if self.paged:
+            self._admit_paged_batched()
+            return
         jnp = self._jnp
         while True:
             try:
@@ -202,6 +254,86 @@ class LLMEngine:
             self._positions = self._positions.at[free].set(n)
             self._active = self._active.at[free].set(True)
 
+    def _admit_paged_batched(self) -> None:
+        """Pull every admissible request, group by prefill bucket, and run
+        ONE batched prefill program per group. Every group pads to a FIXED
+        batch size (min(8, num_slots)): prefill cost is dominated by the
+        per-program dispatch (measured ~130ms flat on tunneled v5e vs
+        ~45ms/row of compute), so padding is nearly free while keeping ONE
+        compile per bucket."""
+        jnp = self._jnp
+        free_slots = [i for i, r in enumerate(self._slots) if r is None]
+        admitted: List[tuple] = []  # (req, slot, pages, bucket)
+        while free_slots:
+            if self._admit_backlog:
+                req = self._admit_backlog.popleft()
+            else:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+            n = len(req.tokens)
+            bucket = self._bucket_for(n)
+            need = max(bucket // self.page_size,
+                       -(-(n + req.max_tokens) // self.page_size))
+            if need > self.allocator.total - 1:
+                req.future.set_exception(ValueError(
+                    f"request needs {need} KV pages but the pool has "
+                    f"{self.allocator.total - 1}; raise total_pages or "
+                    "lower max_tokens"))
+                if req.stream_q is not None:
+                    req.stream_q.put(None)
+                continue
+            pages = self.allocator.alloc(need)
+            if pages is None:
+                # pool exhausted: hold at the HEAD of the line (not the back
+                # of the FIFO) so a big request can't be starved forever by
+                # later-arriving small ones grabbing every freed page
+                self._admit_backlog.appendleft(req)
+                break
+            admitted.append((req, free_slots.pop(0), pages, bucket))
+        if not admitted:
+            return
+        by_bucket: Dict[int, List[tuple]] = {}
+        for item in admitted:
+            by_bucket.setdefault(item[3], []).append(item)
+        size = min(8, self.num_slots)
+        for bucket, group in by_bucket.items():
+            for i in range(0, len(group), size):
+                self._prefill_group(group[i:i + size], bucket, size)
+
+    def _prefill_group(self, chunk: List[tuple], bucket: int, size: int) -> None:
+        """One batched prefill program for `chunk` (padded to `size` rows;
+        pad rows write to the trash page and are discarded)."""
+        jnp = self._jnp
+        n_pages = bucket // self.page_size
+        tokens = np.zeros((size, bucket), np.int32)
+        page_arr = np.zeros((size, n_pages), np.int32)  # pad rows -> trash
+        lengths = np.ones((size,), np.int32)
+        for row, (req, slot, pages, _b) in enumerate(chunk):
+            n = len(req.tokens)
+            tokens[row, :n] = req.tokens
+            page_arr[row] = pages[:n_pages]
+            lengths[row] = min(n, bucket)
+        logits, self.cache = self._prefill(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(page_arr), jnp.asarray(lengths),
+        )
+        firsts = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [size]
+        for row, (req, slot, pages, _b) in enumerate(chunk):
+            n = len(req.tokens)
+            self._slot_pages[slot] = pages
+            trow = np.zeros((self.pages_per_slot,), np.int32)
+            trow[: len(pages)] = pages
+            self._table = self._table.at[slot].set(jnp.asarray(trow))
+            first = firsts[row]  # device scalar
+            req.pending_first = first
+            req.slot = slot
+            self._slots[slot] = req
+            self._tokens = self._tokens.at[slot].set(first)
+            self._positions = self._positions.at[slot].set(n)
+            self._active = self._active.at[slot].set(True)
+
     def _push_stream(self, req: GenRequest) -> None:
         """Forward newly-decoded tokens to a streaming consumer."""
         if req.stream_q is None:
@@ -226,6 +358,12 @@ class LLMEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self._active = self._active.at[slot].set(False)
+        if self.paged and self._slot_pages[slot] is not None:
+            self.allocator.release(self._slot_pages[slot])
+            self._slot_pages[slot] = None
+            # table row back to the trash page so the retired slot's frozen
+            # decode writes can't touch recycled pages
+            self._table = self._table.at[slot].set(0)
         if req is None:
             return
         if req.eos_token is not None and req.eos_token in req.out_tokens:
@@ -249,10 +387,16 @@ class LLMEngine:
                     time.sleep(0.01)  # idle: poll for work (_admit drains FIFO)
                     continue
                 self._key, sub = jax.random.split(self._key)
-                sampled, last, self._positions, self.cache = self._decode(
-                    self.params, self.cache, self._tokens, self._positions,
-                    self._active, sub,
-                )
+                if self.paged:
+                    sampled, last, self._positions, self.cache = self._decode(
+                        self.params, self.cache, self._tokens,
+                        self._positions, self._active, self._table, sub,
+                    )
+                else:
+                    sampled, last, self._positions, self.cache = self._decode(
+                        self.params, self.cache, self._tokens,
+                        self._positions, self._active, sub,
+                    )
                 self._tokens = last
                 self._steps += self.decode_chunk
                 # ONE host sync per chunk: chunk tokens + any pending first
