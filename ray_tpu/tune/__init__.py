@@ -10,17 +10,33 @@ from ray_tpu.train.session import get_checkpoint, report
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
 )
-from ray_tpu.tune.search import choice, grid_search, loguniform, randint, uniform
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    BayesOptSearch,
+    ConcurrencyLimiter,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler",
+    "BasicVariantGenerator",
+    "BayesOptSearch",
+    "ConcurrencyLimiter",
     "FIFOScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",
+    "Searcher",
     "TrialScheduler",
     "TuneConfig",
     "Tuner",

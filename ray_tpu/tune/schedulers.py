@@ -159,3 +159,45 @@ class PopulationBasedTraining(TrialScheduler):
 
     def on_complete(self, trial: "_Trial") -> None:
         self.scores.pop(trial.trial_id, None)
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of the
+    other trials' running averages at the same time step (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial_id -> list of metric values (one per report)
+        self.histories: Dict[str, List[float]] = {}
+
+    def on_result(self, trial: "_Trial", result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        hist = self.histories.setdefault(trial.trial_id, [])
+        hist.append(float(value))
+        if t <= self.grace_period:
+            return CONTINUE
+        others = [h for tid, h in self.histories.items()
+                  if tid != trial.trial_id and len(h) >= len(hist)]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        # running average of each other trial up to this step
+        avgs = sorted(sum(h[: len(hist)]) / len(hist) for h in others)
+        median = avgs[len(avgs) // 2]
+        best = min(hist) if self.mode == "min" else max(hist)
+        worse = best > median if self.mode == "min" else best < median
+        return STOP if worse else CONTINUE
+
+    def on_complete(self, trial: "_Trial") -> None:
+        # histories stay: completed trials keep informing the median
+        pass

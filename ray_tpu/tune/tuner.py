@@ -46,6 +46,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Optional[TrialScheduler] = None
+    # sequential search algorithm (search.Searcher, e.g. BayesOptSearch);
+    # None = the grid x random BasicVariant expansion
+    search_alg: Optional[Any] = None
     seed: int = 0
 
 
@@ -233,6 +236,7 @@ class Tuner:
     def fit(self) -> ResultGrid:
         cfg = self._tune_config
         scheduler = cfg.scheduler or FIFOScheduler()
+        searcher = cfg.search_alg
         trials = self._build_trials()
         payload = cloudpickle.dumps(self._trainable)
         exp_name = self._run_config.name
@@ -242,6 +246,29 @@ class Tuner:
         pending = [t for t in trials if t.status == "PENDING"]
         running: Dict[str, Any] = {}  # trial_id -> in-flight next_result ref
         by_ref: Dict[Any, _Trial] = {}
+        created = len(trials)
+        if searcher is not None:
+            searcher.set_search_properties(cfg.metric or "loss", cfg.mode,
+                                           self._param_space or {})
+
+        def searcher_done(trial: _Trial) -> None:
+            if searcher is not None:
+                searcher.on_trial_complete(
+                    trial.trial_id, result=trial.last_result or None,
+                    error=trial.status == "ERROR")
+
+        def top_up() -> None:
+            nonlocal created
+            while (searcher is not None and created < cfg.num_samples
+                   and len(pending) + len(running) < cfg.max_concurrent_trials):
+                tid = f"trial_{created:05d}"
+                config = searcher.suggest(tid)
+                if config is None:
+                    return  # withheld (concurrency limit) or exhausted
+                t = _Trial(trial_id=tid, config=config)
+                trials.append(t)
+                pending.append(t)
+                created += 1
 
         def launch(trial: _Trial) -> None:
             trial_dir = os.path.join(storage, trial.trial_id)
@@ -256,9 +283,13 @@ class Tuner:
             running[trial.trial_id] = ref
             by_ref[ref] = trial
 
-        while pending or running:
+        while (pending or running
+               or (searcher is not None and created < cfg.num_samples)):
+            top_up()
             while pending and len(running) < cfg.max_concurrent_trials:
                 launch(pending.pop(0))
+            if not running and not pending:
+                break  # searcher exhausted with nothing in flight
             ready, _ = ray_tpu.wait(list(running.values()), num_returns=1,
                                     timeout=300.0)
             if not ready:
@@ -270,11 +301,13 @@ class Tuner:
                 item = ray_tpu.get(ref, timeout=60)
             except Exception as e:  # noqa: BLE001 - actor death = trial error
                 self._finish_trial(trial, error=e, scheduler=scheduler)
+                searcher_done(trial)
                 self._save_state(trials)
                 continue
             if item.get("done"):
                 err = cloudpickle.loads(item["error"]) if item.get("error") else None
                 self._finish_trial(trial, error=err, scheduler=scheduler)
+                searcher_done(trial)
                 self._save_state(trials)
                 continue
             metrics = dict(item.get("metrics") or {})
@@ -292,6 +325,7 @@ class Tuner:
                 trial.status = "STOPPED" if decision == STOP else "TERMINATED"
                 self._stop_actor(trial)
                 scheduler.on_complete(trial)
+                searcher_done(trial)
             elif decision == EXPLOIT:
                 self._exploit(trial, trials, scheduler, pending)
             else:
@@ -326,6 +360,8 @@ class Tuner:
                     t.restore_from = rec.get("checkpoint")
                 trials.append(t)
             return trials
+        if cfg.search_alg is not None:
+            return []  # trials come from the searcher, one suggest at a time
         configs = generate_trial_configs(self._param_space, cfg.num_samples,
                                          seed=cfg.seed)
         return [

@@ -176,3 +176,110 @@ def test_trainer_fit_routes_through_tune(ray_tpu_local, tmp_path):
     # the tune experiment state exists on disk
     assert os.path.exists(os.path.join(str(tmp_path), "fit_tune",
                                        "experiment_state.json"))
+
+
+def test_bayesopt_search_converges(ray_tpu_local, tmp_path):
+    """GP-EI searcher beats random on a smooth 1-d quadratic: after a handful
+    of observations its suggestions concentrate near the optimum (x=3)."""
+    from ray_tpu.tune.search import BayesOptSearch
+
+    tuner = Tuner(
+        _quadratic,
+        param_space={"x": tune.uniform(0.0, 6.0)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=14,
+            max_concurrent_trials=1,
+            search_alg=BayesOptSearch(n_initial=4, candidates=256, seed=1),
+        ),
+        run_config=RunConfig(name="bo", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 14 and not grid.errors
+    best = grid.get_best_result("loss", "min")
+    assert abs(best.metrics["x"] - 3.0) < 0.5
+    # the model-guided tail should sample closer to the optimum than the
+    # random warmup on average
+    xs = [t.last_result["x"] for t in grid._trials]
+    warm = sum(abs(x - 3.0) for x in xs[:4]) / 4
+    tail = sum(abs(x - 3.0) for x in xs[-6:]) / 6
+    assert tail <= warm + 0.5
+
+
+def test_concurrency_limiter_bounds_inflight(ray_tpu_local, tmp_path):
+    from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+
+    seen = []
+
+    class Spy(BasicVariantGenerator):
+        def suggest(self, trial_id):
+            cfg = super().suggest(trial_id)
+            if cfg is not None:
+                seen.append(trial_id)
+            return cfg
+
+    tuner = Tuner(
+        _quadratic,
+        param_space={"x": tune.uniform(0.0, 6.0)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=6,
+            max_concurrent_trials=4,
+            search_alg=ConcurrencyLimiter(Spy(num_samples=6), max_concurrent=2),
+        ),
+        run_config=RunConfig(name="limit", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 6 and not grid.errors
+
+
+def test_bayesopt_handles_mixed_space(ray_tpu_local, tmp_path):
+    from ray_tpu.tune.search import BayesOptSearch
+
+    def trainable(config):
+        from ray_tpu import tune as t
+
+        base = (config["x"] - 2.0) ** 2 + config["layers"]
+        if config["act"] == "gelu":
+            base -= 0.5
+        t.report({"loss": base, "x": config["x"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 4.0),
+                     "layers": tune.randint(1, 4),
+                     "act": tune.choice(["relu", "gelu"])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=8,
+            max_concurrent_trials=2,
+            search_alg=BayesOptSearch(n_initial=3, candidates=128, seed=0),
+        ),
+        run_config=RunConfig(name="bo-mixed", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 8 and not grid.errors
+
+
+def test_median_stopping_rule_stops_stragglers(ray_tpu_local, tmp_path):
+    from ray_tpu.tune import MedianStoppingRule
+
+    def trainable(config):
+        from ray_tpu import tune as t
+
+        for i in range(8):
+            t.report({"loss": config["base"] - 0.1 * i})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"base": tune.grid_search([1.0, 1.1, 1.2, 9.0])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=1, max_concurrent_trials=4,
+            scheduler=MedianStoppingRule(metric="loss", mode="min",
+                                         grace_period=2,
+                                         min_samples_required=2),
+        ),
+        run_config=RunConfig(name="median", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    statuses = {t.last_result.get("base") or t.config["base"]: t.status
+                for t in grid._trials}
+    assert statuses[9.0] == "STOPPED"          # straggler cut early
+    assert statuses[1.0] == "TERMINATED"       # leaders run to completion
