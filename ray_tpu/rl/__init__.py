@@ -1,3 +1,6 @@
+from ray_tpu.rl.dqn import DQNConfig, DQNTrainer
+from ray_tpu.rl.env import CartPoleEnv, ChainEnv, make_env, register_env
+from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.grpo import (
     GRPOConfig,
     compute_group_advantages,
@@ -5,10 +8,14 @@ from ray_tpu.rl.grpo import (
     make_logprob_fn,
 )
 from ray_tpu.rl.ppo import PPOConfig, gae_advantages, make_ppo_step
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rl.trainer import GRPOTrainer
 
 __all__ = [
-    "GRPOConfig", "GRPOTrainer", "PPOConfig",
+    "CartPoleEnv", "ChainEnv", "DQNConfig", "DQNTrainer", "EnvRunner",
+    "EnvRunnerGroup", "GRPOConfig", "GRPOTrainer", "PPOConfig",
+    "PrioritizedReplayBuffer", "ReplayBuffer",
     "compute_group_advantages", "gae_advantages",
-    "make_grpo_step", "make_logprob_fn", "make_ppo_step",
+    "make_env", "make_grpo_step", "make_logprob_fn", "make_ppo_step",
+    "register_env",
 ]

@@ -1,0 +1,135 @@
+"""RL platform tests: envs, replay buffers, env-runner fault tolerance,
+DQN learning (reference: rllib env_runner_group / replay_buffers / dqn
+test strategy, scaled to the 1-core CI box)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (
+    CartPoleEnv,
+    ChainEnv,
+    DQNConfig,
+    DQNTrainer,
+    EnvRunnerGroup,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+from ray_tpu.rl.dqn import make_policy_builder
+
+
+@pytest.fixture(autouse=True)
+def _init(ray_tpu_local):
+    yield
+
+
+class TestEnvs:
+    def test_cartpole_contract(self):
+        env = CartPoleEnv(seed=0)
+        obs, info = env.reset()
+        assert obs.shape == (4,)
+        obs, r, term, trunc, _ = env.step(1)
+        assert r == 1.0 and obs.shape == (4,)
+        # random policy falls over well before the 500-step cap
+        steps = 0
+        term = trunc = False
+        env.reset(seed=1)
+        rng = np.random.default_rng(0)
+        while not (term or trunc):
+            _, _, term, trunc, _ = env.step(int(rng.integers(2)))
+            steps += 1
+        assert term and steps < 500
+
+    def test_chain_rewards_right_walk(self):
+        env = ChainEnv(n=5, max_steps=10)
+        env.reset()
+        total = 0.0
+        for _ in range(10):
+            _, r, _, trunc, _ = env.step(1)
+            total += r
+        assert total >= 10.0  # reaches the end and keeps scoring
+
+
+class TestReplay:
+    def _batch(self, n, base=0.0):
+        return {
+            "obs": np.full((n, 3), base, np.float32),
+            "actions": np.zeros(n, np.int64),
+            "rewards": np.arange(n, dtype=np.float32),
+            "next_obs": np.zeros((n, 3), np.float32),
+            "dones": np.zeros(n, np.float32),
+        }
+
+    def test_ring_wraparound(self):
+        buf = ReplayBuffer(capacity=10)
+        buf.add_batch(self._batch(8, base=1.0))
+        buf.add_batch(self._batch(8, base=2.0))
+        assert len(buf) == 10
+        s = buf.sample(32)
+        assert s["obs"].shape == (32, 3)
+
+    def test_prioritized_prefers_high_td(self):
+        buf = PrioritizedReplayBuffer(capacity=64, alpha=1.0, seed=0)
+        buf.add_batch(self._batch(64))
+        idx = np.arange(64)
+        td = np.zeros(64)
+        td[7] = 100.0  # one transition dominates the priority mass
+        buf.update_priorities(idx, td)
+        counts = np.zeros(64)
+        for _ in range(20):
+            s = buf.sample(32)
+            for i in s["indices"]:
+                counts[i] += 1
+        assert counts[7] > counts.sum() * 0.5
+        assert "weights" in s and s["weights"].max() <= 1.0
+
+
+class TestRunnerGroup:
+    def test_sampling_and_fault_tolerance(self):
+        group = EnvRunnerGroup(
+            "Chain-rt", make_policy_builder(),
+            num_runners=2, env_config={"n": 10}, seed=0,
+        )
+        try:
+            import jax
+
+            from ray_tpu.rl.dqn import q_init
+
+            params = jax.device_get(q_init(10, 2, (16,), jax.random.key(0)))
+            ref = ray_tpu.put(params)
+            batches = group.sample(ref, 32, explore=1.0)
+            assert len(batches) == 2
+            assert all(b["obs"].shape == (32, 10) for b in batches)
+            # kill one runner behind the group's back: sample() must
+            # restart it and still deliver both shares
+            ray_tpu.kill(group._runners[0])
+            batches = group.sample(ref, 16, explore=1.0)
+            assert len(batches) == 2
+        finally:
+            group.stop()
+
+
+def test_dqn_learns_chain():
+    """DQN on the 10-state chain: optimal return/episode is ~100 (walk right
+    to the end, collect 10 per step at the end); random is ~5."""
+    cfg = DQNConfig(
+        env="Chain-rt", env_config={"n": 6, "max_steps": 20},
+        hidden=(32,), num_runners=2, rollout_steps=64,
+        buffer_capacity=5_000, learning_starts=128, batch_size=32,
+        updates_per_iter=16, epsilon_decay_iters=10,
+        target_sync_interval=4, seed=0,
+    )
+    trainer = DQNTrainer(cfg)
+    try:
+        first = None
+        result = {}
+        for _ in range(18):
+            result = trainer.train()
+            if first is None and result["episode_return_mean"] is not None:
+                first = result["episode_return_mean"]
+        assert result["episode_return_mean"] is not None
+        # optimal for n=6, 20 steps: reach end in 5 steps then 15*10 = 150
+        assert result["episode_return_mean"] > 50, result
+        assert result["loss"] is not None
+    finally:
+        trainer.stop()
