@@ -6,10 +6,14 @@ from ray_tpu.util.placement_group import (
     placement_group_table,
     remove_placement_group,
 )
+from ray_tpu.util.queue import Empty, Full, Queue
 
 __all__ = [
     "ActorPool",
+    "Empty",
+    "Full",
     "PlacementGroup",
+    "Queue",
     "get_current_placement_group",
     "placement_group",
     "placement_group_table",

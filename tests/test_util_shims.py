@@ -1,0 +1,115 @@
+"""util shims: distributed Queue, multiprocessing.Pool, joblib backend
+(reference: ray/util/queue.py, util/multiprocessing/pool.py, util/joblib)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(autouse=True)
+def _init(ray_tpu_local):
+    yield
+
+
+class TestQueue:
+    def test_fifo_roundtrip(self):
+        q = Queue()
+        for i in range(5):
+            q.put(i)
+        assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert q.empty()
+        q.shutdown()
+
+    def test_nowait_and_maxsize(self):
+        q = Queue(maxsize=2)
+        q.put(1)
+        q.put(2)
+        assert q.full()
+        with pytest.raises(Full):
+            q.put_nowait(3)
+        assert q.get_nowait() == 1
+        with pytest.raises(Empty):
+            Queue().get_nowait()
+        q.shutdown()
+
+    def test_get_timeout(self):
+        q = Queue()
+        t0 = time.perf_counter()
+        with pytest.raises(Empty):
+            q.get(timeout=0.3)
+        assert time.perf_counter() - t0 < 10
+        q.shutdown()
+
+    def test_cross_task_producer_consumer(self):
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(q, n):
+            for i in range(n):
+                q.put(i)
+            return n
+
+        ref = producer.remote(q, 10)
+        got = sorted(q.get(timeout=30) for _ in range(10))
+        assert got == list(range(10))
+        assert ray_tpu.get(ref) == 10
+        q.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+class TestPool:
+    def test_map_and_apply(self):
+        with Pool(processes=2) as p:
+            assert p.map(_sq, range(8)) == [x * x for x in range(8)]
+            assert p.apply(_sq, (7,)) == 49
+
+    def test_starmap_and_async(self):
+        with Pool(processes=2) as p:
+            assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+            r = p.map_async(_sq, [1, 2, 3])
+            assert r.get(timeout=30) == [1, 4, 9]
+            assert r.ready()
+
+    def test_imap_orders_results(self):
+        with Pool(processes=2) as p:
+            assert list(p.imap(_sq, [3, 1, 2])) == [9, 1, 4]
+            assert sorted(p.imap_unordered(_sq, [3, 1, 2])) == [1, 4, 9]
+
+    def test_initializer_runs_per_worker(self):
+        def init(v):
+            import os
+
+            os.environ["_POOL_INIT"] = str(v)
+
+        def read(_):
+            import os
+
+            return os.environ.get("_POOL_INIT")
+
+        with Pool(processes=2, initializer=init, initargs=(7,)) as p:
+            assert set(p.map(read, range(4))) == {"7"}
+
+    def test_closed_pool_rejects_work(self):
+        p = Pool(processes=1)
+        p.close()
+        with pytest.raises(ValueError):
+            p.map(_sq, [1])
+        p.terminate()
+
+
+def test_joblib_backend():
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
+    assert out == [0, 1, 4, 9, 16, 25]
