@@ -767,17 +767,9 @@ class NodeAgent:
                          owner: str = "", is_error: bool = False,
                          contained: Optional[List[str]] = None) -> Dict[str, Any]:
         oid = ObjectID.from_hex(object_id)
-        try:
-            offset = self.store.reserve(oid, len(payload))
-        except FileExistsError:
-            info = self.store.info(oid)
-            if info and info[1]:
-                return {"ok": True, "existing": "sealed"}  # idempotent retry
-            if info and info[0] != len(payload):
-                self.store.abort(oid)
-                offset = self.store.reserve(oid, len(payload))
-            else:
-                offset = self.store.offset(oid)  # same-size retried reserve
+        if self._reserve_idempotent(oid, len(payload)) == "sealed":
+            return {"ok": True, "existing": "sealed"}  # idempotent retry
+        offset = self.store.offset(oid)
 
         def _write_segment() -> None:
             # shm create/ftruncate/mmap/copy are synchronous syscalls: run off
@@ -1022,6 +1014,144 @@ class NodeAgent:
             except Exception:  # noqa: BLE001
                 pass
             await self._submit_with_retries(spec)
+
+    # ------------------------------------------------------- object broadcast
+    async def _upload_object_to(self, client: "RpcClient", oid: ObjectID,
+                                object_id: str, size: int) -> bool:
+        """Stream the object to one peer. Returns True if the peer NEWLY
+        materialized it, False if it already held a sealed copy (detected on
+        the first chunk — no wasted re-upload). A size-0 object still sends
+        one empty chunk so the receiver can reserve+seal."""
+        reader = ShmReader(oid, size, self.hex, offset=self.store.offset(oid))
+        try:
+            sent = 0
+            chunk = config.fetch_chunk_bytes
+            while True:
+                n = min(chunk, size - sent)
+                data = bytes(reader.buffer[sent : sent + n])
+                if not reader.revalidate():
+                    raise KeyError(f"object {object_id[:16]} evicted mid-push")
+                resp = await client.call(
+                    "receive_chunk", object_id=object_id, total_size=size,
+                    offset=sent, data=data,
+                    is_error=object_id in self.error_objects,
+                    timeout=60.0,
+                )
+                if isinstance(resp, dict) and resp.get("existing") == "sealed":
+                    return sent > 0  # already had it iff detected up front
+                sent += n
+                if sent >= size:
+                    return True
+        finally:
+            reader.close()
+
+    async def rpc_push_object(self, object_id: str,
+                              targets: List[str]) -> Dict[str, Any]:
+        """Binomial-tree broadcast (reference: object_manager/push_manager.h
+        — proactive pushes; here the N-node broadcast costs each node at
+        most 2 uploads and completes in ~log2(N) rounds instead of N serial
+        pulls from one source). This node uploads the object to the head of
+        each half of `targets`; each head recurses on the rest of its half.
+        Unreachable/failed heads are skipped (the next node in the half
+        takes over) and reported in ``failed`` — one dead node never sinks
+        its whole subtree. ``pushed`` counts nodes that NEWLY got a copy."""
+        oid = ObjectID.from_hex(object_id)
+        size = self.store.ensure_local(oid)
+        if size is None or not self.store.contains(oid):
+            raise KeyError(f"object {object_id[:16]} not local to {self.hex[:8]}")
+        targets = [t for t in targets if t != self.hex]
+        if not targets:
+            return {"ok": True, "pushed": 0, "failed": {}}
+        mid = (len(targets) + 1) // 2
+        halves = [h for h in (targets[:mid], targets[mid:]) if h]
+
+        async def push_half(half: List[str]):
+            failed: Dict[str, str] = {}
+            for i, head in enumerate(half):
+                client = await self._peer(head)
+                if client is None:
+                    failed[head] = "no route"
+                    continue
+                try:
+                    newly = await self._upload_object_to(client, oid,
+                                                         object_id, size)
+                except (RpcError, RpcConnectionError, TimeoutError,
+                        KeyError, OSError) as e:
+                    failed[head] = str(e) or type(e).__name__
+                    continue
+                rest = half[i + 1:]
+                try:
+                    sub = await client.call("push_object",
+                                            object_id=object_id,
+                                            targets=rest, timeout=600.0)
+                except (RpcError, RpcConnectionError, TimeoutError) as e:
+                    # the head has its copy but couldn't fan out: count it,
+                    # report the rest as failed
+                    failed.update({t: f"via {head[:8]}: {e}" for t in rest})
+                    return int(newly), failed
+                failed.update(sub.get("failed", {}))
+                return int(newly) + int(sub.get("pushed", 0)), failed
+            return 0, failed
+
+        results = await asyncio.gather(*(push_half(h) for h in halves))
+        failed: Dict[str, str] = {}
+        for _, f in results:
+            failed.update(f)
+        return {"ok": True, "pushed": sum(p for p, _ in results),
+                "failed": failed}
+
+    def _reserve_idempotent(self, oid: ObjectID, size: int) -> str:
+        """Reserve-or-recover shared by every ingest path. Returns "fresh",
+        "reserved" (same-size reservation exists), or "sealed"."""
+        try:
+            self.store.reserve(oid, size)
+            return "fresh"
+        except FileExistsError:
+            info = self.store.info(oid)
+            if info and info[1]:
+                return "sealed"
+            if info is None or info[0] != size:
+                # stale half-written reservation of a DIFFERENT size (or an
+                # entry aborted between reserve and info): recreate
+                self.store.abort(oid)
+                self.store.reserve(oid, size)
+                return "fresh"
+            return "reserved"
+
+    async def rpc_receive_chunk(self, object_id: str, total_size: int,
+                                offset: int, data: bytes,
+                                is_error: bool = False) -> Dict[str, Any]:
+        """Push-side ingest: chunks arrive in order from one pusher; the
+        first chunk reserves, the last seals + registers with the GCS."""
+        oid = ObjectID.from_hex(object_id)
+        if self.store.contains(oid):
+            return {"ok": True, "existing": "sealed"}
+        if offset == 0:
+            if self._reserve_idempotent(oid, total_size) == "sealed":
+                return {"ok": True, "existing": "sealed"}
+        else:
+            info = self.store.info(oid)
+            if info is None or info[0] != total_size:
+                # the reservation vanished mid-push (freed/aborted): fail
+                # loudly — writing into a fresh segment would seal nothing
+                # yet register this node with the GCS as a holder
+                raise KeyError(
+                    f"reservation for {object_id[:16]} vanished mid-push")
+        arena_off = self.store.offset(oid)
+        if arena_off is None and self.store.backend == "arena":
+            raise KeyError(
+                f"arena slot for {object_id[:16]} lost mid-push")
+        writer = ShmWriter(oid, total_size, self.hex, offset=arena_off)
+        if data:
+            writer.buffer[offset : offset + len(data)] = data
+        if offset + len(data) >= total_size:
+            writer.seal()
+            self.store.seal(oid)
+            if is_error:
+                self.error_objects.add(object_id)
+            await self.gcs.call("register_object", object_id=object_id,
+                                size=total_size, node_id=self.hex)
+        return {"ok": True}
 
     async def _pull(self, oid: ObjectID, size: int, locations: List[str]) -> bool:
         """Chunked pull from a peer agent (reference: PullManager/PushManager
