@@ -41,7 +41,15 @@ logger = get_logger("gcs")
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  persist_dir: Optional[str] = None):
+        # persist_dir accepts a plain directory, file://<dir>, or
+        # sqlite://<path> (pluggable persistence; reference:
+        # gcs/store_client/ in-memory vs Redis backends)
         self.persist_dir = persist_dir
+        self._storage = None
+        if persist_dir:
+            from ray_tpu.core.gcs.storage import storage_backend_from_uri
+
+            self._storage = storage_backend_from_uri(persist_dir)
         self.rpc = RpcServer(host, port)
         self.rpc.register_object(self)
         # node_id(hex) -> info dict
@@ -116,7 +124,7 @@ class GcsServer:
 
             self._external = ExternalPolicyClient(config.external_scheduler_address)
             await self._external.start()
-        if self.persist_dir:
+        if self._storage is not None:
             self._restore_snapshot()
             self._persist_task = asyncio.ensure_future(self._persist_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())
@@ -128,8 +136,11 @@ class GcsServer:
     async def stop(self) -> None:
         if self._persist_task:
             self._persist_task.cancel()
-            if self.persist_dir:
-                self._write_snapshot(self._snapshot_state())
+            if self._storage is not None:
+                try:
+                    self._write_snapshot(self._snapshot_state())
+                except Exception:  # noqa: BLE001 - shutdown must reach rpc.stop
+                    logger.exception("final snapshot failed")
         if self._health_task:
             self._health_task.cancel()
         if self._gc_task:
@@ -138,6 +149,8 @@ class GcsServer:
             self._watchdog_task.cancel()
         if self._external:
             await self._external.stop()
+        if self._storage is not None:
+            self._storage.close()
         await self.rpc.stop()
 
     # ------------------------------------------------------------- node table
@@ -1292,29 +1305,15 @@ class GcsServer:
         }
 
     def _write_snapshot(self, state: Dict[str, Any]) -> None:
-        import msgpack
-
-        os.makedirs(self.persist_dir, exist_ok=True)
-        path = os.path.join(self.persist_dir, "gcs_snapshot.msgpack")
-        # unique tmp per writer: stop()'s final on-loop write may race an
-        # in-flight executor write from _persist_loop; sharing one tmp name
-        # would interleave and publish a torn file
-        tmp = f"{path}.{os.getpid()}.{id(state):x}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(msgpack.packb(state, use_bin_type=True))
-        os.replace(tmp, path)  # atomic: readers never see a torn snapshot
+        self._storage.save(state)
 
     def _restore_snapshot(self) -> None:
-        import msgpack
-
-        path = os.path.join(self.persist_dir, "gcs_snapshot.msgpack")
-        if not os.path.exists(path):
-            return
         try:
-            with open(path, "rb") as f:
-                s = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+            s = self._storage.load()
         except Exception:  # noqa: BLE001 - a corrupt snapshot must not brick startup
             logger.exception("snapshot restore failed; starting fresh")
+            return
+        if s is None:
             return
         self.nodes = s.get("nodes", {})
         self.available = s.get("available", {})
