@@ -148,6 +148,11 @@ class NodeAgent:
         self._peer_addr_cache: Dict[str, str] = {}
         self._hb_task: Optional[asyncio.Task] = None
         self._hb_client: Optional[RpcClient] = None  # dedicated heartbeat conn
+        # delta-sync state: version of the current view, whether the full
+        # payload must ride the next tick, and the last view sent
+        self._hb_version = 0
+        self._hb_full_pending = True
+        self._hb_last_view: Optional[tuple] = None
         self._supervise_task: Optional[asyncio.Task] = None
         self._pull_locks: Dict[str, asyncio.Lock] = {}
         self._recon_locks: Dict[str, asyncio.Lock] = {}
@@ -274,12 +279,26 @@ class NodeAgent:
             try:
                 if self._hb_client is None or self._hb_client._closed:  # noqa: SLF001
                     self._hb_client = await RpcClient(self.gcs_address).connect(timeout=2.0)
+                # versioned delta sync (reference: ray_syncer.h): the full
+                # resource/load view rides only when it CHANGED since the
+                # last ack'd send; steady-state ticks are ~40-byte pings
+                view = (dict(self.available),
+                        {"dispatching": self._active_dispatches})
+                if view != self._hb_last_view:
+                    self._hb_version += 1
+                    self._hb_last_view = view
+                    self._hb_full_pending = True
+                kwargs: Dict[str, Any] = {"node_id": self.hex,
+                                          "version": self._hb_version}
+                if self._hb_full_pending:
+                    kwargs["available"] = view[0]
+                    kwargs["load"] = view[1]
                 ok = await self._hb_client.call(
-                    "heartbeat", node_id=self.hex, available=self.available,
-                    load={"dispatching": self._active_dispatches},
+                    "heartbeat",
                     timeout=period * config.health_check_failure_threshold,
+                    **kwargs,
                 )
-                if not ok:
+                if ok is False:
                     await self.gcs.call(
                         "register_node",
                         node_id=self.hex,
@@ -288,8 +307,14 @@ class NodeAgent:
                         labels=self.labels,
                         is_head=self.is_head,
                     )
+                    self._hb_full_pending = True  # fresh GCS: resend view
+                elif isinstance(ok, dict) and ok.get("resync"):
+                    self._hb_full_pending = True  # GCS lost our version
+                else:
+                    self._hb_full_pending = False
             except (RpcConnectionError, TimeoutError):
                 logger.warning("heartbeat to GCS failed")
+                self._hb_full_pending = True
                 await self._reconnect_gcs()
 
     async def _reconnect_gcs(self) -> None:

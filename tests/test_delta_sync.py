@@ -1,0 +1,68 @@
+"""Versioned delta resource sync (reference: common/ray_syncer/ray_syncer.h
+— resource views gossip as versioned deltas, not full payloads)."""
+
+import asyncio
+
+
+def test_heartbeat_delta_protocol():
+    from ray_tpu.core.gcs.server import GcsServer
+
+    async def run():
+        g = GcsServer(port=0)
+        await g.start()
+        try:
+            await g.rpc_register_node(node_id="n1", address="x:1",
+                                      resources={"CPU": 4.0}, labels={})
+            # full view at version 1
+            assert await g.rpc_heartbeat(node_id="n1", version=1,
+                                         available={"CPU": 3.0},
+                                         load={"dispatching": 1}) is True
+            assert g.available["n1"] == {"CPU": 3.0}
+            # unchanged view: bare ping with the same version
+            assert await g.rpc_heartbeat(node_id="n1", version=1) is True
+            # ping with a version the GCS never saw in full -> resync request
+            out = await g.rpc_heartbeat(node_id="n1", version=2)
+            assert isinstance(out, dict) and out["resync"]
+            # full resend at version 2 heals it
+            assert await g.rpc_heartbeat(node_id="n1", version=2,
+                                         available={"CPU": 1.0}) is True
+            assert g.available["n1"] == {"CPU": 1.0}
+            assert await g.rpc_heartbeat(node_id="n1", version=2) is True
+            # unknown node (GCS restart without snapshot) -> re-register
+            assert await g.rpc_heartbeat(node_id="ghost", version=1) is False
+        finally:
+            await g.stop()
+
+    asyncio.run(run())
+
+
+def test_dead_node_heartbeat_forces_reregister():
+    """A node reaped during a partition must get False (re-register), not a
+    happy delta ack that leaves it unschedulable forever."""
+    from ray_tpu.core.gcs.server import GcsServer
+
+    async def run():
+        g = GcsServer(port=0)
+        await g.start()
+        try:
+            await g.rpc_register_node(node_id="n1", address="x:1",
+                                      resources={"CPU": 4.0}, labels={})
+            assert await g.rpc_heartbeat(node_id="n1", version=1,
+                                         available={"CPU": 4.0}) is True
+            await g._mark_node_dead("n1", "missed heartbeats")
+            assert "n1" not in g._node_sync_version  # version dropped
+            # both bare pings and full views now force re-registration
+            assert await g.rpc_heartbeat(node_id="n1", version=1) is False
+            assert await g.rpc_heartbeat(node_id="n1", version=1,
+                                         available={"CPU": 4.0}) is False
+            # re-register heals; first heartbeat carries a full view again
+            await g.rpc_register_node(node_id="n1", address="x:1",
+                                      resources={"CPU": 4.0}, labels={})
+            out = await g.rpc_heartbeat(node_id="n1", version=1)
+            assert isinstance(out, dict) and out["resync"]
+        finally:
+            await g.stop()
+
+    import asyncio
+
+    asyncio.run(run())
