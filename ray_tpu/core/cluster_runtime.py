@@ -384,19 +384,29 @@ class ClusterRuntime(CoreRuntime):
                     if k.startswith("__")}
         if not env:
             return internal or None
-        if "working_dir" in env:
-            path = os.path.abspath(env.pop("working_dir"))
+        def upload_once(cache_key, packager, path: str) -> str:
             # package once per path per driver (contents are snapshotted at
-            # first use, like the reference's URI cache) — re-zipping a large
-            # tree on EVERY submit would dominate submit latency
-            content_hash = self._workdir_hashes.get(path)
+            # first use, like the reference's URI cache) — re-zipping a
+            # large tree on EVERY submit would dominate submit latency
+            content_hash = self._workdir_hashes.get(cache_key)
             if content_hash is None:
-                content_hash, payload = re_mod.package_working_dir(path)
+                content_hash, payload = packager(path)
                 key = re_mod.kv_key(content_hash)
                 if self.gcs.call("kv_get", key=key) is None:
                     self.gcs.call("kv_put", key=key, value=payload)
-                self._workdir_hashes[path] = content_hash
-            env["working_dir_hash"] = content_hash
+                self._workdir_hashes[cache_key] = content_hash
+            return content_hash
+
+        if "working_dir" in env:
+            path = os.path.abspath(env.pop("working_dir"))
+            env["working_dir_hash"] = upload_once(
+                path, re_mod.package_working_dir, path)
+        if "py_modules" in env:
+            env["py_modules_hashes"] = [
+                upload_once(("pymod", os.path.abspath(p)),
+                            re_mod.package_py_module, os.path.abspath(p))
+                for p in env.pop("py_modules")
+            ]
         return {**env, **internal}
 
     def _spec_dict(self, spec: TaskSpec, args: tuple, kwargs: dict) -> Dict[str, Any]:

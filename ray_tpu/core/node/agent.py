@@ -435,8 +435,10 @@ class NodeAgent:
     async def _spawn_worker(self, tpu_chips: Optional[Tuple[int, ...]] = None,
                             renv: Optional[Dict[str, Any]] = None,
                             env_hash: str = "",
-                            staged_cwd: Optional[str] = None) -> _WorkerHandle:
+                            staged: Optional[tuple] = None) -> _WorkerHandle:
         import uuid
+
+        staged_cwd, py_paths = staged if staged else (None, [])
 
         worker_id = uuid.uuid4().hex
         env = dict(os.environ)
@@ -446,10 +448,12 @@ class NodeAgent:
         env["RAY_TPU_NODE_ID"] = self.hex
         if renv and renv.get("env_vars"):
             env.update(renv["env_vars"])
-        if staged_cwd:
-            # staged working_dir: cwd + importable (reference working_dir
-            # plugin semantics)
-            env["PYTHONPATH"] = staged_cwd + os.pathsep + env.get("PYTHONPATH", "")
+        path_prefix = ([staged_cwd] if staged_cwd else []) + list(py_paths)
+        if path_prefix:
+            # staged working_dir: cwd + importable; py_modules: importable
+            # only (reference working_dir / py_modules plugin semantics)
+            env["PYTHONPATH"] = os.pathsep.join(
+                path_prefix + [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
         if tpu_chips is not None:
             # dedicated TPU worker: sees exactly its chip subset
             # (accelerators.py visible_chip_env, reference tpu.py:155-195)
@@ -562,7 +566,7 @@ class NodeAgent:
             raise TimeoutError("TPU chips unavailable")
         staged = await self._stage_runtime_env(renv) if renv else None
         w = await self._spawn_worker(tpu_chips=chips, renv=renv,
-                                     env_hash=env_hash, staged_cwd=staged)
+                                     env_hash=env_hash, staged=staged)
         deadline = time.monotonic() + config.worker_start_timeout_s
         try:
             while not w.ready.is_set():
@@ -649,7 +653,7 @@ class NodeAgent:
                     pool = [w for w in self._workers.values() if w.state != "ACTOR"]
                 if len(pool) < self._max_workers * 2:
                     await self._spawn_worker(renv=renv, env_hash=env_hash,
-                                             staged_cwd=staged)
+                                             staged=staged)
             # event-driven wait for the next freed worker; the 0.25 s cap is
             # only a safety net for spawn failures (a release wakes us at once)
             try:
@@ -675,16 +679,30 @@ class NodeAgent:
             self._idle_workers.pop(h, None)
         return False
 
-    async def _stage_runtime_env(self, renv: Dict[str, Any]) -> Optional[str]:
+    async def _stage_runtime_env(self, renv: Dict[str, Any]) -> tuple:
+        """Stage working_dir + py_modules packages from GCS KV. Returns
+        (cwd_or_None, extra_pythonpath_dirs)."""
         from ray_tpu.core.runtime_env import kv_key, stage_package
 
+        async def fetch(h: str) -> str:
+            # staged-already fast path: _lease_worker stages on EVERY lease,
+            # so skipping the KV download for warm hashes keeps multi-MB
+            # packages off the per-task hot path
+            dest = os.path.join(self.session_dir, "runtime_envs", h)
+            if os.path.isdir(dest):
+                return dest
+            payload = await self.gcs.call("kv_get", key=kv_key(h))
+            if payload is None:
+                raise KeyError(f"runtime_env package {h} not found in GCS KV")
+            return stage_package(payload, h, self.session_dir)
+
+        cwd = None
         h = renv.get("working_dir_hash")
-        if not h:
-            return None
-        payload = await self.gcs.call("kv_get", key=kv_key(h))
-        if payload is None:
-            raise KeyError(f"working_dir package {h} not found in GCS KV")
-        return stage_package(payload, h, self.session_dir)
+        if h:
+            cwd = await fetch(h)
+        mods = renv.get("py_modules_hashes") or []
+        paths = list(await asyncio.gather(*(fetch(mh) for mh in mods)))
+        return cwd, paths
 
     def _notify_worker_free(self, env_hash: str) -> None:
         ev = self._worker_free_events.get(env_hash)

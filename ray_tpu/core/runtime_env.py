@@ -26,7 +26,7 @@ from typing import Any, Dict, Optional, Tuple
 
 MAX_PACKAGE_BYTES = 64 * 1024 * 1024
 _INTERNAL_KEYS = ("__actor_name__", "__actor_namespace__")
-SUPPORTED_KEYS = {"env_vars", "working_dir"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules"}
 REJECTED_KEYS = {"pip", "conda", "container", "py_executable"}
 
 
@@ -54,6 +54,15 @@ def normalize(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
             isinstance(k, str) and isinstance(v, str) for k, v in ev.items()
         ):
             raise ValueError("runtime_env env_vars must be Dict[str, str]")
+    if "py_modules" in env:
+        pm = env["py_modules"]
+        if not isinstance(pm, (list, tuple)) or not all(
+            isinstance(p, str) for p in pm
+        ):
+            raise ValueError(
+                "runtime_env py_modules must be a list of local paths "
+                "(module directories or single .py files)")
+        env["py_modules"] = list(pm)
     return env
 
 
@@ -66,6 +75,31 @@ def env_hash(env: Dict[str, Any]) -> str:
 
 
 # ------------------------------------------------------------- working_dir
+def _zip_tree(zf: "zipfile.ZipFile", path: str, arc_prefix: str,
+              label: str, total: int = 0) -> int:
+    """Deterministic tree zipper shared by every packager: sorted walk,
+    cache/VCS exclusions, fixed ZipInfo metadata (identical trees hash
+    identically), and the MAX_PACKAGE_BYTES budget. Returns running total."""
+    for root, dirs, files in sorted(os.walk(path)):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git", ".venv"))
+        for name in sorted(files):
+            if name.endswith((".pyc", ".pyo")):
+                continue
+            full = os.path.join(root, name)
+            rel = os.path.join(arc_prefix, os.path.relpath(full, path)) \
+                if arc_prefix else os.path.relpath(full, path)
+            total += os.path.getsize(full)
+            if total > MAX_PACKAGE_BYTES:
+                raise ValueError(
+                    f"{label} {path!r} exceeds "
+                    f"{MAX_PACKAGE_BYTES >> 20}MB packaged")
+            zi = zipfile.ZipInfo(rel)  # fixed metadata: deterministic hash
+            with open(full, "rb") as f:
+                zf.writestr(zi, f.read())
+    return total
+
+
 def package_working_dir(path: str) -> Tuple[str, bytes]:
     """Zip a local directory -> (content_hash, payload). Deterministic
     ordering so identical trees share one KV entry."""
@@ -73,25 +107,33 @@ def package_working_dir(path: str) -> Tuple[str, bytes]:
     if not os.path.isdir(path):
         raise ValueError(f"working_dir {path!r} is not a directory")
     buf = io.BytesIO()
-    total = 0
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
-        for root, dirs, files in sorted(os.walk(path)):
-            dirs[:] = sorted(d for d in dirs
-                             if d not in ("__pycache__", ".git", ".venv"))
-            for name in sorted(files):
-                if name.endswith((".pyc", ".pyo")):
-                    continue
-                full = os.path.join(root, name)
-                rel = os.path.relpath(full, path)
-                total += os.path.getsize(full)
-                if total > MAX_PACKAGE_BYTES:
-                    raise ValueError(
-                        f"working_dir {path!r} exceeds "
-                        f"{MAX_PACKAGE_BYTES >> 20}MB packaged"
-                    )
-                zi = zipfile.ZipInfo(rel)  # fixed metadata: deterministic hash
-                with open(full, "rb") as f:
-                    zf.writestr(zi, f.read())
+        _zip_tree(zf, path, "", "working_dir")
+    payload = buf.getvalue()
+    return hashlib.sha1(payload).hexdigest()[:16], payload
+
+
+def package_py_module(path: str) -> Tuple[str, bytes]:
+    """Zip ONE python module (a package directory, zipped under its own
+    basename so the staged root is PYTHONPATH-able, or a single .py file)
+    -> (content_hash, payload). Reference: runtime_env py_modules plugin."""
+    path = os.path.abspath(path.rstrip("/"))
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(path):
+            if not path.endswith(".py"):
+                raise ValueError(f"py_modules file {path!r} must be a .py file")
+            if os.path.getsize(path) > MAX_PACKAGE_BYTES:
+                raise ValueError(
+                    f"py_module {path!r} exceeds "
+                    f"{MAX_PACKAGE_BYTES >> 20}MB packaged")
+            zi = zipfile.ZipInfo(os.path.basename(path))
+            with open(path, "rb") as f:
+                zf.writestr(zi, f.read())
+        elif os.path.isdir(path):
+            _zip_tree(zf, path, os.path.basename(path), "py_module")
+        else:
+            raise ValueError(f"py_modules path {path!r} does not exist")
     payload = buf.getvalue()
     return hashlib.sha1(payload).hexdigest()[:16], payload
 

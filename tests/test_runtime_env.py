@@ -94,3 +94,43 @@ def test_pip_request_fails_loudly(cluster):
 
     with pytest.raises(ValueError, match="hermetic"):
         nop.options(runtime_env={"pip": ["torch"]}).remote()
+
+
+def test_py_modules_importable_in_workers(cluster, tmp_path):
+    """py_modules ship module packages to workers (reference: runtime_env
+    py_modules plugin): the module is importable without being the cwd."""
+    mod = tmp_path / "shiplib"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("MAGIC = 12345\n")
+    (mod / "extra.py").write_text("def double(x):\n    return 2 * x\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def use_module():
+        import shiplib
+        from shiplib.extra import double
+
+        return double(shiplib.MAGIC)
+
+    assert ray_tpu.get(use_module.remote(), timeout=90) == 24690
+
+
+def test_py_modules_single_file(cluster, tmp_path):
+    single = tmp_path / "solo.py"
+    single.write_text("VALUE = 'solo-works'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(single)]})
+    def use_single():
+        import solo
+
+        return solo.VALUE
+
+    assert ray_tpu.get(use_single.remote(), timeout=90) == "solo-works"
+
+
+def test_py_modules_validation():
+    from ray_tpu.core.runtime_env import normalize
+
+    with pytest.raises(ValueError, match="py_modules"):
+        normalize({"py_modules": "not-a-list"})
+    with pytest.raises(ValueError, match="py_modules"):
+        normalize({"py_modules": [42]})
