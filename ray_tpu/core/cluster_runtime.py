@@ -71,6 +71,12 @@ class ClusterRuntime(CoreRuntime):
         self.node_id = node_id
         self.node_hex = node_id.hex()
         self.namespace = namespace
+        # CLIENT MODE (reference: util/client ray:// tier): when the driver
+        # runs on a machine that does not share the agent's /dev/shm, the
+        # object data plane rides chunked RPCs instead of shm mappings.
+        # Set by connect_driver's hostname probe (or force with
+        # address="client://host:port").
+        self.remote_data_plane = False
         self.gcs = SyncRpcClient(gcs_address)
         self.agent = SyncRpcClient(agent_address)
         # distributed-GC identity of THIS process + batched ref sync (adds and
@@ -123,6 +129,15 @@ class ClusterRuntime(CoreRuntime):
                 contained=[r.id.hex() for r in refs] or None,
             )
             return ObjectRef(oid)
+        if self.remote_data_plane:
+            # CLIENT MODE (reference: ray:// Ray Client proxied data plane):
+            # the driver is off-cluster, so large puts stream through the
+            # agent's chunked ingest instead of writing shm directly.
+            # payload stays a buffer view — per-chunk bytes() bounds the
+            # extra copy to one chunk, not the whole object
+            self._put_via_rpc(oid, payload,
+                              [r.id.hex() for r in refs] or None)
+            return ObjectRef(oid)
         resp = self.agent.call("create_object", object_id=oid.hex(),
                                size=len(payload))
         offset = resp.get("offset") if isinstance(resp, dict) else None
@@ -135,13 +150,55 @@ class ClusterRuntime(CoreRuntime):
         )
         return ObjectRef(oid)
 
+    def _put_via_rpc(self, oid: ObjectID, payload,
+                     contained: Optional[List[str]]) -> None:
+        size = len(payload)
+        view = memoryview(payload)
+        chunk = config.fetch_chunk_bytes
+        sent = 0
+        while True:
+            n = min(chunk, size - sent)
+            last = sent + n >= size
+            self.agent.call(
+                "receive_chunk", object_id=oid.hex(), total_size=size,
+                offset=sent, data=bytes(view[sent:sent + n]),
+                contained=contained if last else None,
+                timeout=120.0,
+            )
+            sent += n
+            if last:
+                return
+
+    def _read_via_rpc(self, oid: ObjectID, size: int) -> bytes:
+        data = bytearray()
+        chunk = config.fetch_chunk_bytes
+        while len(data) < size:
+            try:
+                data += self.agent.call(
+                    "read_chunk", object_id=oid.hex(), offset=len(data),
+                    length=min(chunk, size - len(data)), timeout=120.0,
+                )
+            except RpcError as e:
+                if e.remote_type == "KeyError":
+                    # evicted between the metadata reply and this chunk:
+                    # surface as the same transient condition the shm path
+                    # raises so get()'s re-ensure retry loop handles it
+                    raise FileNotFoundError(str(e)) from e
+                raise
+        return bytes(data)
+
     def _read_local(self, oid: ObjectID, size: int, is_error: bool,
                     offset: Optional[int] = None) -> Any:
-        reader = ShmReader(oid, size, self.node_hex, offset=offset)
-        try:
-            value = serialization.unpack(reader.read_bytes(), zero_copy=True)
-        finally:
-            reader.close()
+        if self.remote_data_plane:
+            value = serialization.unpack(self._read_via_rpc(oid, size),
+                                         zero_copy=True)
+        else:
+            reader = ShmReader(oid, size, self.node_hex, offset=offset)
+            try:
+                value = serialization.unpack(reader.read_bytes(),
+                                             zero_copy=True)
+            finally:
+                reader.close()
         if is_error:
             err = value
             if isinstance(err, exc.TaskError):
@@ -755,8 +812,15 @@ class ClusterRuntime(CoreRuntime):
 
 
 def connect_driver(address: str, namespace: Optional[str] = None) -> Tuple[ClusterRuntime, Worker]:
-    """address = GCS host:port. The driver attaches to the head node's agent
-    (or the first alive node) as its local object/task plane."""
+    """address = GCS host:port (optionally with a client:// scheme to force
+    the proxied data plane). The driver attaches to the head node's agent
+    (or the first alive node) as its object/task plane; when the driver is
+    on a DIFFERENT machine (no shared /dev/shm) the data plane is proxied
+    through the agent via chunked RPCs (the Ray Client tier analogue)."""
+    force_client = False
+    if address.startswith("client://"):
+        force_client = True
+        address = address[len("client://"):]
     gcs = SyncRpcClient(address)
     try:
         nodes = [n for n in gcs.call("get_nodes") if n["Alive"]]
@@ -773,6 +837,29 @@ def connect_driver(address: str, namespace: Optional[str] = None) -> Tuple[Clust
         is_driver=True,
         namespace=namespace or "default",
     )
+    if force_client:
+        runtime.remote_data_plane = True
+    else:
+        # a driver on another machine cannot mmap the agent's shm — flip to
+        # the proxied data plane automatically. Primary probe is FUNCTIONAL
+        # (the agent's arena file must exist locally; hostnames can collide
+        # across cloned VMs); hostname compare covers the segments backend.
+        try:
+            import socket
+
+            info = runtime.agent.call("node_info", timeout=10.0)
+            store = info.get("store") or {}
+            if store.get("backend") == "arena":
+                from ray_tpu.core.shm_store import arena_path
+
+                if not os.path.exists(arena_path(runtime.node_hex)):
+                    runtime.remote_data_plane = True
+            else:
+                agent_host = info.get("hostname")
+                if agent_host and agent_host != socket.gethostname():
+                    runtime.remote_data_plane = True
+        except Exception:  # noqa: BLE001 - probe is best-effort
+            pass
     worker = Worker(runtime, JobID.from_int(job_n), node_id=NodeID.from_hex(head["NodeID"]),
                     is_driver=True)
     return runtime, worker

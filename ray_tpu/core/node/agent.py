@@ -696,13 +696,14 @@ class NodeAgent:
                 raise KeyError(f"runtime_env package {h} not found in GCS KV")
             return stage_package(payload, h, self.session_dir)
 
-        cwd = None
         h = renv.get("working_dir_hash")
-        if h:
-            cwd = await fetch(h)
         mods = renv.get("py_modules_hashes") or []
-        paths = list(await asyncio.gather(*(fetch(mh) for mh in mods)))
-        return cwd, paths
+        # one gather: cold staging latency is max(fetches), not
+        # workdir + max(modules)
+        staged = list(await asyncio.gather(
+            *(fetch(x) for x in ([h] if h else []) + list(mods))))
+        cwd = staged.pop(0) if h else None
+        return cwd, staged
 
     def _notify_worker_free(self, env_hash: str) -> None:
         ev = self._worker_free_events.get(env_hash)
@@ -1163,7 +1164,8 @@ class NodeAgent:
 
     async def rpc_receive_chunk(self, object_id: str, total_size: int,
                                 offset: int, data: bytes,
-                                is_error: bool = False) -> Dict[str, Any]:
+                                is_error: bool = False, owner: str = "",
+                                contained: Optional[List[str]] = None) -> Dict[str, Any]:
         """Push-side ingest: chunks arrive in order from one pusher; the
         first chunk reserves, the last seals + registers with the GCS."""
         oid = ObjectID.from_hex(object_id)
@@ -1193,7 +1195,8 @@ class NodeAgent:
             if is_error:
                 self.error_objects.add(object_id)
             await self.gcs.call("register_object", object_id=object_id,
-                                size=total_size, node_id=self.hex)
+                                size=total_size, node_id=self.hex,
+                                owner=owner, contained=contained or None)
         return {"ok": True}
 
     async def _pull(self, oid: ObjectID, size: int, locations: List[str]) -> bool:
@@ -2167,8 +2170,11 @@ class NodeAgent:
             return []
 
     async def rpc_node_info(self) -> Dict[str, Any]:
+        import socket
+
         return {
             "node_id": self.hex,
+            "hostname": socket.gethostname(),
             "address": self.rpc.address,
             "resources": self.total_resources,
             "available": self.available,
