@@ -63,7 +63,8 @@ def init(
         else:
             from ray_tpu.core.cluster_runtime import connect_driver
 
-            runtime, worker = connect_driver(address, namespace=namespace)
+            runtime, worker = connect_driver(address, namespace=namespace,
+                                             log_to_driver=log_to_driver)
         worker.namespace = namespace or "default"
         runtime_ref = runtime
         worker.ref_counter.set_on_zero(lambda oid: runtime_ref.release(oid))

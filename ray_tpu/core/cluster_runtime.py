@@ -169,6 +169,25 @@ class ClusterRuntime(CoreRuntime):
             if last:
                 return
 
+    def start_log_stream(self) -> None:
+        """Subscribe to the cluster's worker-log pubsub channel and mirror
+        lines to this driver's stderr (reference: log_to_driver /
+        _private/log_monitor.py — workers' prints surface at the driver)."""
+        import sys
+
+        def on_logs(msg) -> None:
+            try:
+                prefix = f"({msg['worker'][:8]} {msg['node']})"
+                for line in msg.get("lines") or []:
+                    print(f"{prefix} {line}", file=sys.stderr)
+            except Exception:  # noqa: BLE001 - a bad frame must not kill pubsub
+                pass
+
+        try:
+            self.gcs.subscribe("worker_logs", on_logs)
+        except Exception:  # noqa: BLE001 - log mirroring is best-effort
+            logger.warning("worker-log stream unavailable", exc_info=True)
+
     def _read_via_rpc(self, oid: ObjectID, size: int) -> bytes:
         data = bytearray()
         chunk = config.fetch_chunk_bytes
@@ -811,7 +830,8 @@ class ClusterRuntime(CoreRuntime):
         return self.gcs.call("kv_keys", prefix=prefix)
 
 
-def connect_driver(address: str, namespace: Optional[str] = None) -> Tuple[ClusterRuntime, Worker]:
+def connect_driver(address: str, namespace: Optional[str] = None,
+                   log_to_driver: bool = True) -> Tuple[ClusterRuntime, Worker]:
     """address = GCS host:port (optionally with a client:// scheme to force
     the proxied data plane). The driver attaches to the head node's agent
     (or the first alive node) as its object/task plane; when the driver is
@@ -862,4 +882,6 @@ def connect_driver(address: str, namespace: Optional[str] = None) -> Tuple[Clust
             pass
     worker = Worker(runtime, JobID.from_int(job_n), node_id=NodeID.from_hex(head["NodeID"]),
                     is_driver=True)
+    if log_to_driver:
+        runtime.start_log_stream()
     return runtime, worker

@@ -213,6 +213,15 @@ class GcsServer:
             self._node_sync_version[node_id] = version
         return True
 
+    async def rpc_publish_worker_logs(self, node_id: str, worker_id: str,
+                                      lines: List[str]) -> bool:
+        """Rebroadcast one node's new worker-log lines to subscribed drivers
+        (reference: log monitor -> GCS pubsub -> driver stdout)."""
+        await self.rpc.publish("worker_logs", {
+            "node": node_id, "worker": worker_id, "lines": lines,
+        })
+        return True
+
     async def rpc_drain_node(self, node_id: str) -> bool:
         await self._mark_node_dead(node_id, "drained")
         return True

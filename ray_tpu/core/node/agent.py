@@ -131,6 +131,7 @@ class NodeAgent:
         self._local_wait_q: "_deque[asyncio.Future]" = _deque()
         self._local_waiters = 0  # LIVE waiters (deque may hold stale futures)
         self._memory_task: Optional[asyncio.Task] = None
+        self._log_monitor_task: Optional[asyncio.Task] = None
         # task_id -> OOM kill message: lets the dispatch path distinguish an
         # intentional memory-monitor kill from a plain worker crash
         self._oom_kills: Dict[str, str] = {}
@@ -205,6 +206,8 @@ class NodeAgent:
         await self.gcs.subscribe("nodes", self._on_node_event)
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         self._supervise_task = asyncio.ensure_future(self._supervise_loop())
+        if config.log_to_driver_enabled:
+            self._log_monitor_task = asyncio.ensure_future(self._log_monitor_loop())
         if config.memory_monitor_refresh_ms > 0:
             self._memory_task = asyncio.ensure_future(self._memory_monitor_loop())
         self._pin_flusher = asyncio.ensure_future(self._pin_flush_loop())
@@ -237,6 +240,7 @@ class NodeAgent:
             await self.dashboard.stop()
         for t in (self._hb_task, self._supervise_task, self._memory_task,
                   self._pin_flusher, self._reg_flusher,
+                  self._log_monitor_task,
                   getattr(self, "_watchdog_task", None)):
             if t:
                 t.cancel()
@@ -260,6 +264,75 @@ class NodeAgent:
             client = self._peer_clients.pop(node_id, None)
             if client is not None:
                 spawn(client.close())
+
+    async def _log_monitor_loop(self) -> None:
+        """Tail this node's worker logs and push NEW lines to the GCS
+        "worker_logs" pubsub channel, where connected drivers print them
+        (reference: _private/log_monitor.py:103 — per-node log monitor
+        publishing to the driver's stdout). Only growth after tail start
+        ships; batches are capped so one chatty worker can't flood a tick."""
+        import glob as _glob
+
+        window = 64 * 1024
+        max_lines = 200
+        offsets: Dict[str, int] = {}
+        first_pass = True
+        while True:
+            try:
+                paths = set(_glob.glob(os.path.join(self.session_dir,
+                                                    "worker-*.log")))
+                for gone in set(offsets) - paths:
+                    del offsets[gone]  # dead worker's file removed
+                for path in sorted(paths):
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    prev = offsets.get(path)
+                    if prev is None:
+                        # existing content predates the tail: skip it
+                        prev = offsets[path] = size if first_pass else 0
+                    if size <= prev:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(prev)
+                        chunk = f.read(min(size - prev, window))
+                    cut = chunk.rfind(b"\n")
+                    if cut < 0:
+                        if len(chunk) < window:
+                            continue  # incomplete tail: wait for the newline
+                        # one line bigger than the window: ship truncated and
+                        # move on — never wedge this file's tail forever
+                        lines = [chunk.decode("utf-8", "replace")
+                                 + " ...[line truncated]"]
+                        new_off = prev + len(chunk)
+                    else:
+                        lines = chunk[:cut].decode("utf-8",
+                                                   "replace").splitlines()
+                        if len(lines) > max_lines:
+                            # bound the batch WITHOUT dropping data: advance
+                            # only past the max_lines-th newline
+                            idx = -1
+                            for _ in range(max_lines):
+                                idx = chunk.find(b"\n", idx + 1)
+                            lines = lines[:max_lines]
+                            new_off = prev + idx + 1
+                        else:
+                            new_off = prev + cut + 1
+                    worker = os.path.basename(path)[len("worker-"):-len(".log")]
+                    # publish BEFORE advancing: a failed publish re-sends the
+                    # batch next tick instead of dropping it
+                    await self.gcs.call(
+                        "publish_worker_logs", node_id=self.hex[:8],
+                        worker_id=worker, lines=lines, timeout=5.0,
+                    )
+                    offsets[path] = new_off
+                first_pass = False
+            except (RpcConnectionError, RpcError, TimeoutError, OSError):
+                pass  # GCS hiccup: batch re-sends next tick
+            except Exception:  # noqa: BLE001 - the tailer must survive
+                logger.exception("log monitor tick failed")
+            await asyncio.sleep(config.log_monitor_interval_s)
 
     async def _heartbeat_loop(self) -> None:
         period = config.health_check_period_ms / 1000.0

@@ -212,3 +212,25 @@ def test_custom_resources_cluster(cluster):
         assert ray_tpu.get(use_widget.remote(), timeout=120)
     finally:
         cluster.remove_node(node)
+
+
+def test_worker_logs_stream_to_driver(cluster, capfd):
+    """log_to_driver parity (reference: _private/log_monitor.py): a worker's
+    print surfaces on the driver's stderr, prefixed with worker/node ids."""
+    import time as _time
+
+    @ray_tpu.remote
+    def chatty():
+        print("HELLO-LOG-STREAM-7", flush=True)
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    deadline = _time.time() + 15
+    seen = ""
+    while _time.time() < deadline:
+        out, err = capfd.readouterr()
+        seen += out + err
+        if "HELLO-LOG-STREAM-7" in seen:
+            break
+        _time.sleep(0.3)
+    assert "HELLO-LOG-STREAM-7" in seen
