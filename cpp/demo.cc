@@ -1,0 +1,63 @@
+// C++ client demo: connect to a running cluster, exercise KV, state, and
+// the object plane. Usage: demo <gcs_host> <gcs_port>
+// Prints one status line per step; "CPP-DEMO-OK" on success (the pytest
+// integration test greps for it).
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "ray_tpu_client.h"
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <gcs_host> <gcs_port>\n", argv[0]);
+    return 2;
+  }
+  const std::string host = argv[1];
+  const int port = std::atoi(argv[2]);
+  try {
+    rtpu::Client gcs = rtpu::Client::Connect(host, port);
+
+    gcs.KvPut("cpp-demo-key", "hello-from-cpp");
+    std::string back = gcs.KvGet("cpp-demo-key");
+    if (back != "hello-from-cpp") throw std::runtime_error("kv mismatch");
+    std::printf("kv roundtrip: %s\n", back.c_str());
+
+    rtpu::Value nodes = gcs.GetNodes();
+    std::printf("nodes: %zu\n", nodes.as_array().size());
+    if (nodes.as_array().empty()) throw std::runtime_error("no nodes");
+
+    rtpu::Value total = gcs.ClusterResources();
+    const rtpu::Value* cpu = total.get("CPU");
+    std::printf("cluster CPU: %.1f\n", cpu ? cpu->as_float() : 0.0);
+
+    // object plane: talk to the head node's agent
+    std::string agent_addr;
+    for (const auto& n : nodes.as_array()) {
+      const rtpu::Value* head = n.get("is_head");
+      if (head && head->b) agent_addr = n.get("NodeManagerAddress")->as_str();
+    }
+    if (agent_addr.empty())
+      agent_addr = nodes.as_array()[0].get("NodeManagerAddress")->as_str();
+    auto colon = agent_addr.rfind(':');
+    rtpu::Client agent = rtpu::Client::Connect(
+        agent_addr.substr(0, colon),
+        std::atoi(agent_addr.substr(colon + 1).c_str()));
+
+    std::string payload(1 << 20, '\x5a');  // 1MB: multiple chunks
+    payload += "tail-marker";
+    std::string oid = agent.PutObject(payload, 256 * 1024);
+    std::printf("put object %s (%zu bytes)\n", oid.substr(0, 16).c_str(),
+                payload.size());
+    std::string fetched = agent.GetObject(oid);
+    if (fetched != payload) throw std::runtime_error("object mismatch");
+    std::printf("object roundtrip ok (%zu bytes)\n", fetched.size());
+
+    std::printf("CPP-DEMO-OK\n");
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "CPP-DEMO-FAILED: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,280 @@
+// Minimal msgpack codec for the ray_tpu RPC wire format.
+//
+// Reference capability: the reference ships a full C++ worker API
+// (cpp/include/ray/api/*.h) over gRPC/protobuf; this framework's wire
+// format is length-prefixed msgpack (ray_tpu/core/rpc.py:6), so the C++
+// client needs exactly the msgpack subset the protocol uses: nil, bool,
+// ints, float64, str, bin, array, map<str, value>. Self-contained header —
+// no external msgpack dependency in the image.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace rtpu {
+
+struct Value;
+using Array = std::vector<Value>;
+using Map = std::map<std::string, Value>;
+
+struct Value {
+  enum class Type { Nil, Bool, Int, Float, Str, Bin, Arr, MapT };
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;       // Str and Bin payloads
+  std::shared_ptr<Array> arr;
+  std::shared_ptr<Map> map;
+
+  Value() = default;
+  static Value Nil() { return Value(); }
+  static Value B(bool v) { Value x; x.type = Type::Bool; x.b = v; return x; }
+  static Value I(int64_t v) { Value x; x.type = Type::Int; x.i = v; return x; }
+  static Value F(double v) { Value x; x.type = Type::Float; x.f = v; return x; }
+  static Value S(std::string v) {
+    Value x; x.type = Type::Str; x.s = std::move(v); return x;
+  }
+  static Value Bin(std::string v) {
+    Value x; x.type = Type::Bin; x.s = std::move(v); return x;
+  }
+  static Value A(Array v) {
+    Value x; x.type = Type::Arr; x.arr = std::make_shared<Array>(std::move(v));
+    return x;
+  }
+  static Value M(Map v) {
+    Value x; x.type = Type::MapT; x.map = std::make_shared<Map>(std::move(v));
+    return x;
+  }
+
+  bool is_nil() const { return type == Type::Nil; }
+  int64_t as_int() const {
+    if (type == Type::Int) return i;
+    if (type == Type::Float) return static_cast<int64_t>(f);
+    throw std::runtime_error("msgpack: not an int");
+  }
+  double as_float() const {
+    if (type == Type::Float) return f;
+    if (type == Type::Int) return static_cast<double>(i);
+    throw std::runtime_error("msgpack: not a float");
+  }
+  const std::string& as_str() const {
+    if (type != Type::Str && type != Type::Bin)
+      throw std::runtime_error("msgpack: not a string/bin");
+    return s;
+  }
+  const Array& as_array() const {
+    if (type != Type::Arr) throw std::runtime_error("msgpack: not an array");
+    return *arr;
+  }
+  const Map& as_map() const {
+    if (type != Type::MapT) throw std::runtime_error("msgpack: not a map");
+    return *map;
+  }
+  const Value* get(const std::string& key) const {
+    if (type != Type::MapT) return nullptr;
+    auto it = map->find(key);
+    return it == map->end() ? nullptr : &it->second;
+  }
+};
+
+// ----------------------------------------------------------------- encoding
+inline void put_be(std::string& out, uint64_t v, int bytes) {
+  for (int k = bytes - 1; k >= 0; --k)
+    out.push_back(static_cast<char>((v >> (8 * k)) & 0xff));
+}
+
+inline void encode(const Value& v, std::string& out) {
+  switch (v.type) {
+    case Value::Type::Nil:
+      out.push_back(static_cast<char>(0xc0));
+      break;
+    case Value::Type::Bool:
+      out.push_back(static_cast<char>(v.b ? 0xc3 : 0xc2));
+      break;
+    case Value::Type::Int: {
+      int64_t x = v.i;
+      if (x >= 0 && x < 128) {
+        out.push_back(static_cast<char>(x));
+      } else if (x < 0 && x >= -32) {
+        out.push_back(static_cast<char>(x));
+      } else {
+        out.push_back(static_cast<char>(0xd3));  // int64
+        put_be(out, static_cast<uint64_t>(x), 8);
+      }
+      break;
+    }
+    case Value::Type::Float: {
+      out.push_back(static_cast<char>(0xcb));
+      uint64_t bits;
+      std::memcpy(&bits, &v.f, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case Value::Type::Str: {
+      size_t n = v.s.size();
+      if (n < 32) {
+        out.push_back(static_cast<char>(0xa0 | n));
+      } else if (n < 256) {
+        out.push_back(static_cast<char>(0xd9));
+        put_be(out, n, 1);
+      } else if (n < 65536) {
+        out.push_back(static_cast<char>(0xda));
+        put_be(out, n, 2);
+      } else {
+        out.push_back(static_cast<char>(0xdb));
+        put_be(out, n, 4);
+      }
+      out.append(v.s);
+      break;
+    }
+    case Value::Type::Bin: {
+      size_t n = v.s.size();
+      if (n < 256) {
+        out.push_back(static_cast<char>(0xc4));
+        put_be(out, n, 1);
+      } else if (n < 65536) {
+        out.push_back(static_cast<char>(0xc5));
+        put_be(out, n, 2);
+      } else {
+        out.push_back(static_cast<char>(0xc6));
+        put_be(out, n, 4);
+      }
+      out.append(v.s);
+      break;
+    }
+    case Value::Type::Arr: {
+      size_t n = v.arr->size();
+      if (n < 16) {
+        out.push_back(static_cast<char>(0x90 | n));
+      } else if (n < 65536) {
+        out.push_back(static_cast<char>(0xdc));
+        put_be(out, n, 2);
+      } else {
+        out.push_back(static_cast<char>(0xdd));
+        put_be(out, n, 4);
+      }
+      for (const auto& e : *v.arr) encode(e, out);
+      break;
+    }
+    case Value::Type::MapT: {
+      size_t n = v.map->size();
+      if (n < 16) {
+        out.push_back(static_cast<char>(0x80 | n));
+      } else if (n < 65536) {
+        out.push_back(static_cast<char>(0xde));
+        put_be(out, n, 2);
+      } else {
+        out.push_back(static_cast<char>(0xdf));
+        put_be(out, n, 4);
+      }
+      for (const auto& kv : *v.map) {
+        encode(Value::S(kv.first), out);
+        encode(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+// ----------------------------------------------------------------- decoding
+struct Decoder {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  uint64_t be(int bytes) {
+    if (p + bytes > end) throw std::runtime_error("msgpack: truncated");
+    uint64_t v = 0;
+    for (int k = 0; k < bytes; ++k) v = (v << 8) | *p++;
+    return v;
+  }
+  std::string raw(size_t n) {
+    if (p + n > end) throw std::runtime_error("msgpack: truncated");
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+
+  Value decode() {
+    if (p >= end) throw std::runtime_error("msgpack: truncated");
+    uint8_t tag = *p++;
+    if (tag < 0x80) return Value::I(tag);                   // pos fixint
+    if (tag >= 0xe0) return Value::I(static_cast<int8_t>(tag));  // neg fixint
+    if ((tag & 0xf0) == 0x90) return arr(tag & 0x0f);       // fixarray
+    if ((tag & 0xf0) == 0x80) return mapv(tag & 0x0f);      // fixmap
+    if ((tag & 0xe0) == 0xa0) return Value::S(raw(tag & 0x1f));  // fixstr
+    switch (tag) {
+      case 0xc0: return Value::Nil();
+      case 0xc2: return Value::B(false);
+      case 0xc3: return Value::B(true);
+      case 0xc4: return Value::Bin(raw(be(1)));
+      case 0xc5: return Value::Bin(raw(be(2)));
+      case 0xc6: return Value::Bin(raw(be(4)));
+      case 0xca: {  // float32
+        uint32_t bits = static_cast<uint32_t>(be(4));
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value::F(f);
+      }
+      case 0xcb: {  // float64
+        uint64_t bits = be(8);
+        double d;
+        std::memcpy(&d, &bits, 8);
+        return Value::F(d);
+      }
+      case 0xcc: return Value::I(static_cast<int64_t>(be(1)));
+      case 0xcd: return Value::I(static_cast<int64_t>(be(2)));
+      case 0xce: return Value::I(static_cast<int64_t>(be(4)));
+      case 0xcf: return Value::I(static_cast<int64_t>(be(8)));  // u64 (may wrap)
+      case 0xd0: return Value::I(static_cast<int8_t>(be(1)));
+      case 0xd1: return Value::I(static_cast<int16_t>(be(2)));
+      case 0xd2: return Value::I(static_cast<int32_t>(be(4)));
+      case 0xd3: return Value::I(static_cast<int64_t>(be(8)));
+      case 0xd9: return Value::S(raw(be(1)));
+      case 0xda: return Value::S(raw(be(2)));
+      case 0xdb: return Value::S(raw(be(4)));
+      case 0xdc: return arr(be(2));
+      case 0xdd: return arr(be(4));
+      case 0xde: return mapv(be(2));
+      case 0xdf: return mapv(be(4));
+      default:
+        throw std::runtime_error("msgpack: unsupported tag " +
+                                 std::to_string(tag));
+    }
+  }
+
+  Value arr(size_t n) {
+    Array a;
+    a.reserve(n);
+    for (size_t k = 0; k < n; ++k) a.push_back(decode());
+    return Value::A(std::move(a));
+  }
+  Value mapv(size_t n) {
+    Map m;
+    for (size_t k = 0; k < n; ++k) {
+      Value key = decode();
+      m.emplace(key.as_str(), decode());
+    }
+    return Value::M(std::move(m));
+  }
+};
+
+inline std::string pack(const Value& v) {
+  std::string out;
+  encode(v, out);
+  return out;
+}
+
+inline Value unpack(const std::string& data) {
+  Decoder d{reinterpret_cast<const uint8_t*>(data.data()),
+            reinterpret_cast<const uint8_t*>(data.data()) + data.size()};
+  return d.decode();
+}
+
+}  // namespace rtpu

@@ -1,0 +1,207 @@
+#include "ray_tpu_client.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstring>
+#include <random>
+#include <stdexcept>
+
+namespace rtpu {
+
+namespace {
+
+void write_all(int fd, const char* data, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::send(fd, data, n, 0);
+    if (w <= 0) throw std::runtime_error("rpc: send failed");
+    data += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+void read_all(int fd, char* data, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::recv(fd, data, n, 0);
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+      throw std::runtime_error("rpc: receive timeout");
+    if (r <= 0) throw std::runtime_error("rpc: connection closed");
+    data += r;
+    n -= static_cast<size_t>(r);
+  }
+}
+
+void set_recv_timeout(int fd, double seconds) {
+  struct timeval tv;
+  tv.tv_sec = static_cast<long>(seconds);
+  tv.tv_usec = static_cast<long>((seconds - tv.tv_sec) * 1e6);
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+std::string random_object_id() {
+  static const char* hex = "0123456789abcdef";
+  std::random_device rd;
+  std::mt19937_64 gen(rd());
+  std::string id;
+  id.reserve(48);  // 24-byte ids, hex-encoded (ray_tpu/core/ids.py)
+  for (int k = 0; k < 48; ++k) id.push_back(hex[gen() % 16]);
+  return id;
+}
+
+}  // namespace
+
+Client Client::Connect(const std::string& host, int port, double timeout_s) {
+  Client c;
+  c.fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (c.fd_ < 0) throw std::runtime_error("rpc: socket() failed");
+  set_recv_timeout(c.fd_, timeout_s);
+  int one = 1;
+  ::setsockopt(c.fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+    throw std::runtime_error("rpc: bad host " + host);
+  if (::connect(c.fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    throw std::runtime_error("rpc: connect to " + host + " failed");
+  c.host_ = host;
+  return c;
+}
+
+Client::~Client() { Close(); }
+
+Client::Client(Client&& other) noexcept
+    : fd_(other.fd_), next_id_(other.next_id_), host_(std::move(other.host_)) {
+  other.fd_ = -1;
+}
+
+Client& Client::operator=(Client&& other) noexcept {
+  if (this != &other) {
+    Close();
+    fd_ = other.fd_;
+    next_id_ = other.next_id_;
+    host_ = std::move(other.host_);
+    other.fd_ = -1;
+  }
+  return *this;
+}
+
+void Client::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Value Client::Call(const std::string& method, Map params, double timeout_s) {
+  if (fd_ < 0) throw std::runtime_error("rpc: client closed");
+  int64_t id = next_id_++;
+  Map req;
+  req.emplace("i", Value::I(id));
+  req.emplace("m", Value::S(method));
+  req.emplace("p", Value::M(std::move(params)));
+  std::string body = pack(Value::M(std::move(req)));
+  uint32_t len = static_cast<uint32_t>(body.size());
+  char header[4];
+  std::memcpy(header, &len, 4);  // u32 LITTLE-endian (rpc.py struct '<I')
+  // per-call receive deadline (a timeout mid-frame desynchronizes the
+  // stream, so any read failure below also closes the connection)
+  set_recv_timeout(fd_, timeout_s);
+  try {
+    write_all(fd_, header, 4);
+    write_all(fd_, body.data(), body.size());
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    for (;;) {
+      if (std::chrono::steady_clock::now() > deadline)
+        throw std::runtime_error("rpc: deadline exceeded for " + method);
+      char hdr[4];
+      read_all(fd_, hdr, 4);
+      uint32_t rlen;
+      std::memcpy(&rlen, hdr, 4);
+      std::string rbody(rlen, '\0');
+      read_all(fd_, rbody.data(), rlen);
+      Value msg = unpack(rbody);
+      const Value* mid = msg.get("i");
+      if (mid == nullptr) continue;  // pubsub push frame: not for us
+      if (mid->as_int() != id) continue;  // stale reply (timed-out call)
+      if (const Value* err = msg.get("e")) {
+        const Array& e = err->as_array();
+        throw std::runtime_error("rpc remote " + e.at(0).as_str() + ": " +
+                                 e.at(1).as_str());
+      }
+      const Value* res = msg.get("r");
+      return res ? *res : Value::Nil();
+    }
+  } catch (const std::runtime_error& e) {
+    // remote exceptions leave the stream aligned (a full frame was read);
+    // transport errors do not — close so later Calls can't parse garbage
+    if (std::strncmp(e.what(), "rpc remote ", 11) != 0) Close();
+    throw;
+  }
+}
+
+// ---------------------------------------------------------------- gcs api
+void Client::KvPut(const std::string& key, const std::string& value) {
+  Map p;
+  p.emplace("key", Value::S(key));
+  p.emplace("value", Value::Bin(value));
+  Call("kv_put", std::move(p));
+}
+
+std::string Client::KvGet(const std::string& key) {
+  Map p;
+  p.emplace("key", Value::S(key));
+  Value v = Call("kv_get", std::move(p));
+  return v.is_nil() ? std::string() : v.as_str();
+}
+
+Value Client::GetNodes() { return Call("get_nodes", Map{}); }
+
+Value Client::ClusterResources() { return Call("cluster_resources", Map{}); }
+
+// ------------------------------------------------------------ object plane
+std::string Client::PutObject(const std::string& payload, size_t chunk_bytes) {
+  std::string oid = random_object_id();
+  size_t size = payload.size();
+  size_t sent = 0;
+  for (;;) {
+    size_t n = std::min(chunk_bytes, size - sent);
+    Map p;
+    p.emplace("object_id", Value::S(oid));
+    p.emplace("total_size", Value::I(static_cast<int64_t>(size)));
+    p.emplace("offset", Value::I(static_cast<int64_t>(sent)));
+    p.emplace("data", Value::Bin(payload.substr(sent, n)));
+    Call("receive_chunk", std::move(p), 60.0);
+    sent += n;
+    if (sent >= size) return oid;
+  }
+}
+
+std::string Client::GetObject(const std::string& object_id, double timeout_s,
+                              size_t chunk_bytes) {
+  Map e;
+  e.emplace("object_id", Value::S(object_id));
+  e.emplace("timeout_s", Value::F(timeout_s));
+  Value meta = Call("ensure_local", std::move(e), timeout_s + 5.0);
+  size_t size = static_cast<size_t>(meta.get("size")->as_int());
+  std::string out;
+  out.reserve(size);
+  while (out.size() < size) {
+    Map p;
+    p.emplace("object_id", Value::S(object_id));
+    p.emplace("offset", Value::I(static_cast<int64_t>(out.size())));
+    p.emplace("length",
+              Value::I(static_cast<int64_t>(
+                  std::min(chunk_bytes, size - out.size()))));
+    out += Call("read_chunk", std::move(p), 60.0).as_str();
+  }
+  return out;
+}
+
+}  // namespace rtpu

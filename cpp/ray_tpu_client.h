@@ -1,0 +1,70 @@
+// ray_tpu C++ client API.
+//
+// Reference capability: cpp/include/ray/api/*.h (the C++ worker API) and
+// gcs/global_state_accessor — a native client for cluster state, KV, and
+// the object plane. This v1 client speaks the framework's native RPC
+// protocol (length-prefixed msgpack frames, ray_tpu/core/rpc.py:6)
+// directly over TCP:
+//
+//   Client gcs = Client::Connect("127.0.0.1", 6379);
+//   gcs.KvPut("k", "v");  gcs.KvGet("k");
+//   auto nodes = gcs.GetNodes();
+//   Client agent = Client::Connect(host, agent_port);
+//   std::string oid = agent.PutObject(payload);   // chunked ingest
+//   std::string back = agent.GetObject(gcs, oid); // ensure_local + chunks
+//
+// Object payloads are raw bytes tagged with the framework's serialization
+// header by the caller (Python drivers interop via
+// ray_tpu.core.serialization). Task/actor submission from C++ is a
+// roadmap item — it needs a cross-language function descriptor registry
+// (reference: java/xlang), not just a wire client.
+
+#pragma once
+
+#include <string>
+
+#include "msgpack_lite.h"
+
+namespace rtpu {
+
+class Client {
+ public:
+  // Connect to any ray_tpu RPC server (GCS or node agent).
+  static Client Connect(const std::string& host, int port,
+                        double timeout_s = 10.0);
+  ~Client();
+  Client(Client&& other) noexcept;
+  Client& operator=(Client&& other) noexcept;
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Generic RPC: method + params map -> result value. Throws
+  // std::runtime_error on transport errors and remote exceptions.
+  Value Call(const std::string& method, Map params,
+             double timeout_s = 30.0);
+
+  // ---- GCS helpers ------------------------------------------------------
+  void KvPut(const std::string& key, const std::string& value);
+  std::string KvGet(const std::string& key);  // "" if missing
+  Value GetNodes();
+  Value ClusterResources();
+
+  // ---- object plane (agent helpers) -------------------------------------
+  // Store raw bytes as a new object; returns its 48-hex object id.
+  std::string PutObject(const std::string& payload,
+                        size_t chunk_bytes = 4 << 20);
+  // Fetch an object's raw bytes (agent pulls cross-node if needed).
+  std::string GetObject(const std::string& object_id,
+                        double timeout_s = 30.0,
+                        size_t chunk_bytes = 4 << 20);
+
+  void Close();
+
+ private:
+  Client() = default;
+  int fd_ = -1;
+  int64_t next_id_ = 1;
+  std::string host_;
+};
+
+}  // namespace rtpu

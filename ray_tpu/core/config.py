@@ -177,9 +177,6 @@ _DEFINITIONS = [
      "Default actor restarts."),
     ("max_lineage_bytes", 512 * 1024 * 1024, int,
      "Budget of task-spec lineage kept for object reconstruction."),
-    ("log_to_driver_enabled", True, bool,
-     "Agents tail worker logs and push new lines to connected drivers "
-     "via GCS pubsub (the log-monitor plane)."),
     ("log_monitor_interval_s", 0.5, float,
      "How often each agent checks worker logs for growth."),
     ("health_check_period_ms", 1000, int,

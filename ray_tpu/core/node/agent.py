@@ -206,7 +206,7 @@ class NodeAgent:
         await self.gcs.subscribe("nodes", self._on_node_event)
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         self._supervise_task = asyncio.ensure_future(self._supervise_loop())
-        if config.log_to_driver_enabled:
+        if config.log_to_driver:
             self._log_monitor_task = asyncio.ensure_future(self._log_monitor_loop())
         if config.memory_monitor_refresh_ms > 0:
             self._memory_task = asyncio.ensure_future(self._memory_monitor_loop())
@@ -270,13 +270,26 @@ class NodeAgent:
         "worker_logs" pubsub channel, where connected drivers print them
         (reference: _private/log_monitor.py:103 — per-node log monitor
         publishing to the driver's stdout). Only growth after tail start
-        ships; batches are capped so one chatty worker can't flood a tick."""
+        ships; batches are capped so one chatty worker can't flood a tick.
+        NOTE: fan-out is cluster-wide — every connected driver mirrors
+        every worker's output; per-job filtering (the reference scopes
+        lines by owning job) needs a worker->job registry and is a
+        roadmap item. Opt out per driver with init(log_to_driver=False)
+        or cluster-wide with config log_to_driver=false."""
         import glob as _glob
 
         window = 64 * 1024
         max_lines = 200
+        # content existing at monitor START predates the tail: skip it.
+        # Priming here (not lazily inside the tick) keeps the semantics
+        # stable even if the first ticks fail on a GCS hiccup — files
+        # appearing later always tail from 0.
         offsets: Dict[str, int] = {}
-        first_pass = True
+        for path in _glob.glob(os.path.join(self.session_dir, "worker-*.log")):
+            try:
+                offsets[path] = os.path.getsize(path)
+            except OSError:
+                pass
         while True:
             try:
                 paths = set(_glob.glob(os.path.join(self.session_dir,
@@ -290,8 +303,7 @@ class NodeAgent:
                         continue
                     prev = offsets.get(path)
                     if prev is None:
-                        # existing content predates the tail: skip it
-                        prev = offsets[path] = size if first_pass else 0
+                        prev = offsets[path] = 0  # new file: tail from start
                     if size <= prev:
                         continue
                     with open(path, "rb") as f:
@@ -303,22 +315,21 @@ class NodeAgent:
                             continue  # incomplete tail: wait for the newline
                         # one line bigger than the window: ship truncated and
                         # move on — never wedge this file's tail forever
-                        lines = [chunk.decode("utf-8", "replace")
-                                 + " ...[line truncated]"]
+                        raw = [chunk]
+                        suffix = " ...[line truncated]"
                         new_off = prev + len(chunk)
                     else:
-                        lines = chunk[:cut].decode("utf-8",
-                                                   "replace").splitlines()
-                        if len(lines) > max_lines:
-                            # bound the batch WITHOUT dropping data: advance
-                            # only past the max_lines-th newline
-                            idx = -1
-                            for _ in range(max_lines):
-                                idx = chunk.find(b"\n", idx + 1)
-                            lines = lines[:max_lines]
-                            new_off = prev + idx + 1
+                        # split on the SAME delimiter the offset math uses
+                        # (splitlines() also breaks on \r/\x85 and would
+                        # desynchronize count vs byte position)
+                        raw = chunk[:cut].split(b"\n")
+                        suffix = ""
+                        if len(raw) > max_lines:
+                            raw = raw[:max_lines]
+                            new_off = prev + sum(len(l) + 1 for l in raw)
                         else:
                             new_off = prev + cut + 1
+                    lines = [l.decode("utf-8", "replace") + suffix for l in raw]
                     worker = os.path.basename(path)[len("worker-"):-len(".log")]
                     # publish BEFORE advancing: a failed publish re-sends the
                     # batch next tick instead of dropping it
@@ -327,7 +338,6 @@ class NodeAgent:
                         worker_id=worker, lines=lines, timeout=5.0,
                     )
                     offsets[path] = new_off
-                first_pass = False
             except (RpcConnectionError, RpcError, TimeoutError, OSError):
                 pass  # GCS hiccup: batch re-sends next tick
             except Exception:  # noqa: BLE001 - the tailer must survive
