@@ -20,8 +20,11 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
+    "get_multiplexed_model_id",
+    "multiplexed",
     "Application",
     "AutoscalingConfig",
     "Deployment",

@@ -50,8 +50,9 @@ class DeploymentResponse:
         except ReplicaOverloadedError:
             # raced an overloaded replica: fall back to the router's
             # retrying call path
-            return self._router.call(self._method, self._args, self._kwargs,
-                                     timeout=timeout)
+            return self._router.call(
+                self._method, self._args, self._kwargs, timeout=timeout,
+                multiplexed_model_id=getattr(self, "_multiplexed_model_id", ""))
         finally:
             if not self._done:
                 self._done = True
@@ -60,33 +61,45 @@ class DeploymentResponse:
 
 class DeploymentHandle:
     def __init__(self, controller, app_name: str, method: str = "__call__",
-                 stream: bool = False):
+                 stream: bool = False, multiplexed_model_id: str = ""):
         self._controller = controller
         self._app = app_name
         self._method = method
         self._stream = stream
+        self._multiplexed_model_id = multiplexed_model_id
 
     def options(self, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._controller, self._app, method_name or self._method,
             stream=self._stream if stream is None else stream,
+            multiplexed_model_id=(self._multiplexed_model_id
+                                  if multiplexed_model_id is None
+                                  else multiplexed_model_id),
         )
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self._controller, self._app, name, stream=self._stream)
+        return DeploymentHandle(self._controller, self._app, name,
+                                stream=self._stream,
+                                multiplexed_model_id=self._multiplexed_model_id)
 
     def remote(self, *args, **kwargs):
         router = _router_for(self._controller, self._app)
         if self._stream:
             # generator of VALUES, yielded as the replica produces them
             # (reference: handle.options(stream=True) -> DeploymentResponseGenerator)
-            return router.call_streaming(self._method, args, kwargs)
-        ref, replica = router.route(self._method, args, kwargs)
+            return router.call_streaming(
+                self._method, args, kwargs,
+                multiplexed_model_id=self._multiplexed_model_id)
+        ref, replica = router.route(
+            self._method, args, kwargs,
+            multiplexed_model_id=self._multiplexed_model_id)
         resp = DeploymentResponse(router, ref, replica)
         resp._method = self._method
         resp._args = args
         resp._kwargs = kwargs
+        resp._multiplexed_model_id = self._multiplexed_model_id
         return resp

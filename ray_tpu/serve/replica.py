@@ -53,7 +53,12 @@ class Replica:
                 reconfigure(dep.user_config)
 
     # ------------------------------------------------------------- requests
-    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       multiplexed_model_id: str = "") -> Any:
+        from ray_tpu.serve.multiplex import (
+            _reset_request_model_id, _set_request_model_id,
+        )
+
         with self._lock:
             if self._ongoing >= self._max_ongoing:
                 raise ReplicaOverloadedError(
@@ -62,6 +67,7 @@ class Replica:
                 )
             self._ongoing += 1
             self._total += 1
+        mux_token = _set_request_model_id(multiplexed_model_id)
         try:
             if self._is_function:
                 if method != "__call__":
@@ -81,16 +87,22 @@ class Replica:
                 result = _run_coro(result)
             return result
         finally:
+            _reset_request_model_id(mux_token)
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict):
+    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict,
+                                 multiplexed_model_id: str = ""):
         """Streaming variant: a generator method, invoked by routers with
         ``num_returns="streaming"`` so each yielded item is sealed and
         consumable before the request finishes (reference:
         serve/_private/proxy.py:542 streaming send_request_to_replica +
         replica.py:533 handle_request_streaming). Non-generator results
         stream as a single item."""
+        from ray_tpu.serve.multiplex import (
+            _reset_request_model_id, _set_request_model_id,
+        )
+
         with self._lock:
             if self._ongoing >= self._max_ongoing:
                 raise ReplicaOverloadedError(
@@ -99,6 +111,7 @@ class Replica:
                 )
             self._ongoing += 1
             self._total += 1
+        mux_token = _set_request_model_id(multiplexed_model_id)
         try:
             if self._is_function:
                 fn = self._callable
@@ -120,6 +133,7 @@ class Replica:
             else:
                 yield result
         finally:
+            _reset_request_model_id(mux_token)
             with self._lock:
                 self._ongoing -= 1
 

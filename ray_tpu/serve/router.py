@@ -34,6 +34,10 @@ class Router:
         self._replicas: List[Any] = []
         self._queue_len: Dict[Any, int] = {}  # cached estimates per handle
         self._version = 0
+        # sticky multiplex routing: model id -> last replica that served it
+        # (locality without control traffic; reference tracks exact
+        # model->replica maps over long-poll)
+        self._model_affinity: Dict[str, Any] = {}
         self._synced = threading.Event()
         self._stopped = False
         self._lock = threading.Lock()
@@ -54,6 +58,12 @@ class Router:
             # keep queue estimates for survivors; new replicas start at 0
             self._queue_len = {r: self._queue_len.get(r, 0) for r in new}
             self._replicas = new
+            # purge pins to replicas no longer in the set (scale-down would
+            # otherwise leak dead handles in the affinity map forever)
+            live = set(map(id, new))
+            for mid in [m for m, r in self._model_affinity.items()
+                        if id(r) not in live]:
+                del self._model_affinity[mid]
         self._synced.set()
 
     def _listen_loop(self) -> None:
@@ -85,59 +95,81 @@ class Router:
             return
         self._synced.wait(timeout=10.0)
 
-    def _pick(self) -> Any:
-        """Pow-2: two random candidates, lower cached queue length wins."""
+    def _pick(self, model_id: str = "") -> Any:
+        """Pow-2: two random candidates, lower cached queue length wins.
+        A multiplexed model id prefers its sticky replica while healthy
+        (model stays loaded there), falling back to pow-2 + re-pin."""
         with self._lock:
             replicas = list(self._replicas)
+            sticky = self._model_affinity.get(model_id) if model_id else None
         if not replicas:
             raise exc.RayTpuError("no replicas available")
+        if sticky is not None and sticky in replicas:
+            return sticky
         if len(replicas) == 1:
-            return replicas[0]
-        a, b = random.sample(replicas, 2)
-        with self._lock:
-            qa = self._queue_len.get(a, 0)
-            qb = self._queue_len.get(b, 0)
-        return a if qa <= qb else b
+            choice = replicas[0]
+        else:
+            a, b = random.sample(replicas, 2)
+            with self._lock:
+                qa = self._queue_len.get(a, 0)
+                qb = self._queue_len.get(b, 0)
+            choice = a if qa <= qb else b
+        if model_id:
+            with self._lock:
+                self._model_affinity[model_id] = choice
+        return choice
 
     def _note(self, replica, delta: int) -> None:
         with self._lock:
             if replica in self._queue_len:
                 self._queue_len[replica] = max(0, self._queue_len.get(replica, 0) + delta)
 
+    def _unpin(self, model_id: str, replica) -> None:
+        """Overloaded sticky replica: drop the pin so the retry re-picks by
+        pow-2 (and re-pins wherever it lands)."""
+        with self._lock:
+            if self._model_affinity.get(model_id) is replica:
+                del self._model_affinity[model_id]
+
     def _evict(self, replica) -> None:
         with self._lock:
             if replica in self._replicas:
                 self._replicas.remove(replica)
             self._queue_len.pop(replica, None)
+            for mid in [m for m, r in self._model_affinity.items()
+                        if r is replica]:
+                del self._model_affinity[mid]
 
     # -------------------------------------------------------------- routing
     def route(self, method: str, args: tuple, kwargs: dict,
-              max_attempts: int = 10) -> Tuple[Any, Any]:
+              max_attempts: int = 10, multiplexed_model_id: str = "") -> Tuple[Any, Any]:
         """Submit to a chosen replica; returns (result ObjectRef, replica)."""
         self._refresh()
         last: Optional[Exception] = None
         for _ in range(max_attempts):
             try:
-                replica = self._pick()
+                replica = self._pick(multiplexed_model_id)
             except exc.RayTpuError as e:
                 last = e
                 time.sleep(0.2)
                 self._refresh(force=True)
                 continue
             self._note(replica, +1)
-            ref = replica.handle_request.remote(method, args, kwargs)
+            ref = replica.handle_request.remote(
+                method, args, kwargs,
+                multiplexed_model_id=multiplexed_model_id)
             return ref, replica
         raise exc.RayTpuError(f"no route for {self._app}.{method}: {last}")
 
     def route_streaming(self, method: str, args: tuple, kwargs: dict,
-                        max_attempts: int = 10):
+                        max_attempts: int = 10, multiplexed_model_id: str = ""):
         """Submit a streaming request; returns (ObjectRefGenerator, replica).
         Items become available as the replica's generator yields."""
         self._refresh()
         last: Optional[Exception] = None
         for _ in range(max_attempts):
             try:
-                replica = self._pick()
+                replica = self._pick(multiplexed_model_id)
             except exc.RayTpuError as e:
                 last = e
                 time.sleep(0.2)
@@ -146,11 +178,13 @@ class Router:
             self._note(replica, +1)
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming"
-            ).remote(method, args, kwargs)
+            ).remote(method, args, kwargs,
+                     multiplexed_model_id=multiplexed_model_id)
             return gen, replica
         raise exc.RayTpuError(f"no route for {self._app}.{method}: {last}")
 
-    def call_streaming(self, method: str, args: tuple, kwargs: dict):
+    def call_streaming(self, method: str, args: tuple, kwargs: dict,
+                       multiplexed_model_id: str = ""):
         """Route AND stream VALUES, retrying overload/replica-death on other
         replicas while no item has been delivered yet (after the first item
         the stream is already partially consumed; mid-stream failures
@@ -159,7 +193,9 @@ class Router:
 
         attempts = 0
         while True:
-            gen, replica = self.route_streaming(method, args, kwargs)
+            gen, replica = self.route_streaming(
+                method, args, kwargs,
+                multiplexed_model_id=multiplexed_model_id)
             it = iter(gen)
             try:
                 try:
@@ -178,6 +214,8 @@ class Router:
                         if isinstance(e, (exc.ActorDiedError, exc.ActorUnavailableError)):
                             self._evict(replica)
                             self._refresh(force=True)
+                        elif multiplexed_model_id:
+                            self._unpin(multiplexed_model_id, replica)
                         attempts += 1
                         if attempts > 20:
                             raise
@@ -191,7 +229,8 @@ class Router:
             finally:
                 self._note(replica, -1)
 
-    def call(self, method: str, args: tuple, kwargs: dict, timeout: Optional[float] = None):
+    def call(self, method: str, args: tuple, kwargs: dict, timeout: Optional[float] = None,
+             multiplexed_model_id: str = ""):
         """Route AND resolve, retrying overloads on other replicas
         (the synchronous fast path used by the proxy)."""
         from ray_tpu.serve.replica import ReplicaOverloadedError
@@ -199,7 +238,9 @@ class Router:
         deadline = None if timeout is None else time.monotonic() + timeout
         attempts = 0
         while True:
-            ref, replica = self.route(method, args, kwargs)
+            ref, replica = self.route(
+                method, args, kwargs,
+                multiplexed_model_id=multiplexed_model_id)
             try:
                 remaining = None if deadline is None else max(0.1, deadline - time.monotonic())
                 result = ray_tpu.get(ref, timeout=remaining)
@@ -208,6 +249,8 @@ class Router:
             except Exception as e:  # noqa: BLE001
                 self._note(replica, -1)
                 if isinstance(e, ReplicaOverloadedError) or "ReplicaOverloadedError" in str(type(e).__name__):
+                    if multiplexed_model_id:
+                        self._unpin(multiplexed_model_id, replica)
                     attempts += 1
                     if attempts > 20:
                         raise

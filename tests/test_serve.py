@@ -299,3 +299,47 @@ def test_per_node_proxies_cluster():
         serve.shutdown()
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_multiplexed_models_lru_and_sticky_routing(serve_instance):
+    """Model multiplexing (reference: serve/multiplex.py): per-replica LRU
+    of loaded models, request model id from context, sticky routing."""
+    from ray_tpu import serve
+    from ray_tpu.serve import get_multiplexed_model_id, multiplexed
+
+    class MuxServer:
+        def __init__(self):
+            self.loads = []
+
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": len(model_id)}
+
+        def __call__(self, x):
+            model = self.get_model(get_multiplexed_model_id())
+            return {"model": model["id"], "y": x * model["scale"]}
+
+        def load_count(self, _=None):
+            return list(self.loads)
+
+    app = serve.deployment(MuxServer, name="mux", num_replicas=1).bind()
+    handle = serve.run(app, name="mux")
+    h_a = handle.options(multiplexed_model_id="aa")
+    h_b = handle.options(multiplexed_model_id="bbb")
+    assert h_a.remote(2).result(timeout=60) == {"model": "aa", "y": 4}
+    assert h_b.remote(2).result(timeout=60) == {"model": "bbb", "y": 6}
+    # cache hits: repeated calls load nothing new
+    assert h_a.remote(3).result(timeout=60) == {"model": "aa", "y": 6}
+    loads = handle.options(method_name="load_count").remote(0).result(timeout=60)
+    assert loads == ["aa", "bbb"]
+    # third model evicts the LRU ("bbb": "aa" was just touched); "aa" stays
+    # cached, re-requesting "bbb" reloads it
+    handle.options(multiplexed_model_id="cccc").remote(1).result(timeout=60)
+    h_a.remote(1).result(timeout=60)
+    loads = handle.options(method_name="load_count").remote(0).result(timeout=60)
+    assert loads == ["aa", "bbb", "cccc"]
+    h_b.remote(1).result(timeout=60)
+    loads = handle.options(method_name="load_count").remote(0).result(timeout=60)
+    assert loads == ["aa", "bbb", "cccc", "bbb"]
+    serve.delete("mux")
