@@ -38,8 +38,10 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.remote_function import RemoteFunction
 from ray_tpu.core.streaming import ObjectRefGenerator
 from ray_tpu import exceptions
+from ray_tpu.profiling import profile
 
 __all__ = [
+    "profile",
     "__version__",
     "ActorClass",
     "ActorHandle",

@@ -240,6 +240,7 @@ class _LocalActor:
             self.runtime._on_actor_dead(self)
         finally:
             w.set_task_context(None)
+            _flush_profile_local()
 
     def _main(self) -> None:
         self._construct()
@@ -619,6 +620,7 @@ class LocalRuntime(CoreRuntime):
                     return
                 finally:
                     w.set_task_context(None)
+            _flush_profile_local()
         finally:
             pg, idx = grant
             if pg is not None:
@@ -891,6 +893,7 @@ class LocalRuntime(CoreRuntime):
                 actor.kill()
         finally:
             w.set_task_context(None)
+            _flush_profile_local()
             for dep in spec.dependencies():
                 w.ref_counter.remove_submitted(dep)
 
@@ -932,6 +935,7 @@ class LocalRuntime(CoreRuntime):
             self._stream_mark_error(spec)
         finally:
             w.set_task_context(None)
+            _flush_profile_local()
             for dep in spec.dependencies():
                 w.ref_counter.remove_submitted(dep)
 
@@ -1100,3 +1104,14 @@ def _detect_tpu_chips() -> int:
         except Exception:
             return 0
     return 0
+
+
+def _flush_profile_local() -> None:
+    """Move any ray_tpu.profile() spans into the local-runtime span log
+    (no agent in-process; read back via ray_tpu.profiling.local_spans())."""
+    try:
+        from ray_tpu import profiling
+
+        profiling.flush_local()
+    except Exception:  # noqa: BLE001 - observability is best-effort
+        pass

@@ -167,6 +167,7 @@ class NodeAgent:
         self._sched_drainer: Optional[asyncio.Task] = None
         # task_id -> lifecycle state (observability; state API reads this)
         self._task_states: Dict[str, str] = {}
+        self._profile_events: List[Dict[str, Any]] = []
         # task_id -> [(wall_ts, state), ...] transition log (timeline source;
         # reference capability: core_worker/profile_event.h -> GcsTaskManager
         # -> `ray timeline` chrome trace)
@@ -2091,6 +2092,22 @@ class NodeAgent:
 
     async def rpc_task_states(self) -> Dict[str, str]:
         return dict(self._task_states)
+
+    async def rpc_report_profile_events(self, worker_id: str,
+                                        events: List[Dict[str, Any]]) -> bool:
+        """User profile spans from a worker (reference: profile_event.h ->
+        GcsTaskManager); bounded ring, served to the dashboard timeline."""
+        if len(events) > 1000:
+            logger.warning("profile report from %s truncated: %d of %d spans "
+                           "kept", worker_id[:8], 1000, len(events))
+        for e in events[:1000]:
+            e["worker_id"] = worker_id
+            self._profile_events.append(e)
+        del self._profile_events[:-20000]
+        return True
+
+    async def rpc_profile_events(self) -> List[Dict[str, Any]]:
+        return list(self._profile_events)
 
     async def rpc_task_events(self) -> Dict[str, List[Tuple[float, str]]]:
         """Per-task (wall_ts, state) transition logs for the timeline."""

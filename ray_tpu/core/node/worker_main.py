@@ -315,6 +315,26 @@ class WorkerProcess:
     async def rpc_run_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         return await self._loop.run_in_executor(self._exec_pool, self._execute_task, spec)
 
+
+    def _flush_profile_spans(self) -> None:
+        """Ship this thread's recorded profile spans to the agent (one RPC,
+        only when ray_tpu.profile() was used in the task)."""
+        from ray_tpu import profiling
+
+        spans = profiling.drain()
+        if not spans:
+            return
+        try:
+            # fire-and-forget: the reply is unused and exceptions are
+            # swallowed, so never stall the task-completion path on it
+            asyncio.run_coroutine_threadsafe(
+                self.agent.call("report_profile_events",
+                                worker_id=self.worker_id, events=spans),
+                self._loop,
+            )
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            pass
+
     def _execute_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         from ray_tpu.core.worker import global_worker
 
@@ -351,6 +371,7 @@ class WorkerProcess:
                 return {"state": "error", "inline_returns": inline}
             finally:
                 w.set_task_context(None)
+                self._flush_profile_spans()
                 # borrows registered during execution must reach the GCS
                 # while the task pin still protects them
                 try:
@@ -393,6 +414,7 @@ class WorkerProcess:
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
         finally:
             w.set_task_context(None)
+            self._flush_profile_spans()
 
     async def rpc_run_actor_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         if self.actor_instance is None:
@@ -448,6 +470,7 @@ class WorkerProcess:
             return {"state": "error"}
         finally:
             w.set_task_context(None)
+            self._flush_profile_spans()
             try:
                 self._runtime.flush_refs()
             except Exception:  # noqa: BLE001

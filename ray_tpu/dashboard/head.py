@@ -201,8 +201,13 @@ class DashboardHead:
     async def _timeline(self) -> Dict[str, Any]:
         """Chrome-trace (catapult) JSON: one 'X' span per task-state phase,
         grouped by node (pid) — loads in Perfetto / chrome://tracing."""
+        import asyncio as _asyncio
+
         events: List[Dict[str, Any]] = []
-        for node, task_events in await self._each_agent("task_events"):
+        # the two cluster fan-outs are independent: fetch concurrently
+        task_fan, profile_fan = await _asyncio.gather(
+            self._each_agent("task_events"), self._each_agent("profile_events"))
+        for node, task_events in task_fan:
             pid = f"node:{node['NodeID'][:8]}"
             for task_id, transitions in task_events.items():
                 tid = task_id[:12]
@@ -221,6 +226,22 @@ class DashboardHead:
                         "tid": tid,
                         "args": {"task_id": task_id},
                     })
+        # user profile spans (ray_tpu.profile(...) inside tasks; reference:
+        # profile_event.h spans on the `ray timeline` view)
+        for node, spans in profile_fan:
+            pid = f"node:{node['NodeID'][:8]}"
+            for s in spans:
+                events.append({
+                    "name": s.get("name", "span"),
+                    "cat": "user",
+                    "ph": "X",
+                    "ts": s["start"] * 1e6,
+                    "dur": max(1.0, (s["end"] - s["start"]) * 1e6),
+                    "pid": pid,
+                    "tid": f"worker:{str(s.get('worker_id', ''))[:8]}",
+                    "args": {k: v for k, v in s.items()
+                             if k in ("task_id", "extra")},
+                })
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -123,3 +123,44 @@ def test_404(dash_cluster):
         raise AssertionError("expected 404")
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_profile_spans_reach_timeline(dash_cluster):
+    """ray_tpu.profile() spans inside tasks land on /api/timeline as
+    cat='user' chrome-trace events (reference: profile_event.h +
+    `ray timeline`)."""
+    import json
+    import time
+    import urllib.request
+
+    cluster, dash_addr, _held = dash_cluster
+
+    @ray_tpu.remote
+    def traced():
+        with ray_tpu.profile("phase-one", extra={"k": 1}):
+            time.sleep(0.02)
+        with ray_tpu.profile("phase-two"):
+            time.sleep(0.01)
+        return "done"
+
+    assert ray_tpu.get(traced.remote(), timeout=60) == "done"
+    deadline = time.time() + 20
+    names = set()
+    while time.time() < deadline:
+        with urllib.request.urlopen(f"{dash_addr}/api/timeline", timeout=10) as r:
+            trace = json.load(r)
+        names = {e["name"] for e in trace["traceEvents"] if e["cat"] == "user"}
+        if {"phase-one", "phase-two"} <= names:
+            break
+        time.sleep(0.5)
+    assert {"phase-one", "phase-two"} <= names, names
+
+
+def test_profile_spans_local_runtime():
+    """Local runtime has no agent: spans drain into the in-process log."""
+    import ray_tpu.profiling as prof
+
+    with prof.profile("solo-span"):
+        pass
+    prof.flush_local()
+    assert any(s["name"] == "solo-span" for s in prof.local_spans())
