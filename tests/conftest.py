@@ -45,3 +45,15 @@ def shutdown_only():
     yield ray_tpu
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
+
+
+# `kill -USR1 <pytest pid>` dumps all thread stacks (hang diagnosis on the
+# single-core CI box; the cluster components get the same hook from
+# setup_component_logging)
+try:
+    import faulthandler as _fh
+    import signal as _sig
+
+    _fh.register(_sig.SIGUSR1, all_threads=True, chain=True)
+except (ImportError, ValueError, AttributeError):
+    pass
