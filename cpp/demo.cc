@@ -54,6 +54,44 @@ int main(int argc, char** argv) {
     if (fetched != payload) throw std::runtime_error("object mismatch");
     std::printf("object roundtrip ok (%zu bytes)\n", fetched.size());
 
+    // ---- task frontend: C++ submits, a Python worker executes ----------
+    rtpu::Session session(gcs, agent);
+    std::string rid = session.SubmitTask(
+        "xlang:operator:add", {rtpu::Value::I(2), rtpu::Value::I(40)});
+    rtpu::Value result = session.GetValue(rid, 60.0);
+    if (result.as_int() != 42) throw std::runtime_error("task result != 42");
+    std::printf("task roundtrip ok (operator.add -> %lld)\n",
+                static_cast<long long>(result.as_int()));
+
+    // error propagation: remote ZeroDivisionError must throw here
+    std::string bad = session.SubmitTask(
+        "xlang:operator:truediv", {rtpu::Value::I(1), rtpu::Value::I(0)});
+    bool threw = false;
+    try {
+      session.GetValue(bad, 60.0);
+    } catch (const std::exception& e) {
+      threw = std::string(e.what()).find("ZeroDivisionError") !=
+              std::string::npos;
+    }
+    if (!threw) throw std::runtime_error("remote error did not propagate");
+    std::printf("task error propagation ok\n");
+
+    // ---- actor frontend ------------------------------------------------
+    std::string aid = session.CreateActor("xlang:collections:Counter", {});
+    rtpu::Array items;
+    items.push_back(rtpu::Value::S("a"));
+    items.push_back(rtpu::Value::S("b"));
+    items.push_back(rtpu::Value::S("a"));
+    session.GetValue(
+        session.ActorCall(aid, "update", {rtpu::Value::A(std::move(items))}),
+        60.0);
+    rtpu::Value cnt_total =
+        session.GetValue(session.ActorCall(aid, "total", {}), 60.0);
+    if (cnt_total.as_int() != 3)
+      throw std::runtime_error("actor total != 3");
+    std::printf("actor roundtrip ok (Counter.total -> %lld)\n",
+                static_cast<long long>(cnt_total.as_int()));
+
     std::printf("CPP-DEMO-OK\n");
     return 0;
   } catch (const std::exception& e) {

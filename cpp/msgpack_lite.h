@@ -187,6 +187,8 @@ inline void encode(const Value& v, std::string& out) {
 struct Decoder {
   const uint8_t* p;
   const uint8_t* end;
+  int depth = 0;
+  static constexpr int kMaxDepth = 64;
 
   uint64_t be(int bytes) {
     if (p + bytes > end) throw std::runtime_error("msgpack: truncated");
@@ -249,18 +251,31 @@ struct Decoder {
     }
   }
 
+  // A corrupt frame could claim 2^32 elements or nest arbitrarily deep;
+  // every element costs >= 1 byte on the wire, so cap reserve() by the
+  // remaining buffer and bound recursion before touching the payload.
+  void check_container(size_t n) {
+    if (n > static_cast<size_t>(end - p))
+      throw std::runtime_error("msgpack: container count exceeds frame");
+    if (++depth > kMaxDepth)
+      throw std::runtime_error("msgpack: nesting too deep");
+  }
   Value arr(size_t n) {
+    check_container(n);
     Array a;
     a.reserve(n);
     for (size_t k = 0; k < n; ++k) a.push_back(decode());
+    --depth;
     return Value::A(std::move(a));
   }
   Value mapv(size_t n) {
+    check_container(n);
     Map m;
     for (size_t k = 0; k < n; ++k) {
       Value key = decode();
       m.emplace(key.as_str(), decode());
     }
+    --depth;
     return Value::M(std::move(m));
   }
 };

@@ -8,6 +8,7 @@
 
 #include <cerrno>
 #include <chrono>
+#include <cstdio>
 #include <cstring>
 #include <random>
 #include <stdexcept>
@@ -189,7 +190,14 @@ std::string Client::GetObject(const std::string& object_id, double timeout_s,
   e.emplace("object_id", Value::S(object_id));
   e.emplace("timeout_s", Value::F(timeout_s));
   Value meta = Call("ensure_local", std::move(e), timeout_s + 5.0);
-  size_t size = static_cast<size_t>(meta.get("size")->as_int());
+  const Value* size_v = meta.get("size");
+  if (size_v == nullptr)
+    throw std::runtime_error("GetObject: malformed ensure_local reply for " +
+                             object_id);
+  size_t size = static_cast<size_t>(size_v->as_int());
+  const Value* err_v = meta.get("is_error");
+  bool is_error = err_v != nullptr && err_v->type == Value::Type::Bool &&
+                  err_v->b;
   std::string out;
   out.reserve(size);
   while (out.size() < size) {
@@ -201,7 +209,222 @@ std::string Client::GetObject(const std::string& object_id, double timeout_s,
                   std::min(chunk_bytes, size - out.size()))));
     out += Call("read_chunk", std::move(p), 60.0).as_str();
   }
+  if (is_error) {
+    // RTXL error envelope ({"__rtpu_error__", "message"}) decodes to text;
+    // pickled (Python-side) errors surface opaquely but still THROW.
+    std::string detail = "task error object " + object_id;
+    if (out.size() > 4 && out.compare(0, 4, "RTXL") == 0) {
+      try {
+        Value env = unpack(out.substr(4));
+        const Value* msg = env.get("message");
+        const Value* typ = env.get("__rtpu_error__");
+        detail = (typ ? typ->as_str() : "TaskError") + std::string(": ") +
+                 (msg ? msg->as_str() : "");
+      } catch (const std::exception&) {
+      }
+    }
+    throw std::runtime_error("rtpu task failed: " + detail);
+  }
   return out;
+}
+
+// ------------------------------------------------------------- task frontend
+namespace {
+
+std::string random_hex(int chars) {
+  static const char* hex = "0123456789abcdef";
+  std::random_device rd;
+  std::mt19937_64 gen(rd());
+  std::string id;
+  id.reserve(chars);
+  for (int k = 0; k < chars; ++k) id.push_back(hex[gen() % 16]);
+  return id;
+}
+
+std::string job_hex(uint32_t job) {
+  // 4-byte big-endian job id (ray_tpu/core/ids.py JobID.from_int)
+  char buf[9];
+  std::snprintf(buf, sizeof(buf), "%08x", job);
+  return std::string(buf);
+}
+
+std::string xlang_payload(Array args) {
+  // RTXL + msgpack([args, {}]) == serialization.xlang_pack((args, kwargs))
+  Array tuple;
+  tuple.push_back(Value::A(std::move(args)));
+  tuple.push_back(Value::M(Map{}));
+  return "RTXL" + pack(Value::A(std::move(tuple)));
+}
+
+}  // namespace
+
+Session::Session(Client& gcs, Client& agent) : gcs_(gcs), agent_(agent) {
+  client_id_ = "w:cpp" + random_hex(12);
+  job_ = static_cast<uint32_t>(gcs_.Call("next_job_id", Map{}).as_int());
+}
+
+std::string Session::NewTaskId() {
+  // TaskID.for_normal_task: 8 random + 8 zero (actor pad) + 4 job (ids.py)
+  return random_hex(16) + std::string(16, '0') + job_hex(job_);
+}
+
+Map Session::TaskSpec(const std::string& task_id, const std::string& function,
+                      Array args, double num_cpus) {
+  Map resources;
+  resources.emplace("CPU", Value::F(num_cpus));
+  Map strategy;
+  strategy.emplace("kind", Value::S("default"));
+  Array returns;
+  returns.push_back(Value::S(task_id + "00000001"));  // return index 1
+  Map spec;
+  spec.emplace("task_id", Value::S(task_id));
+  spec.emplace("name", Value::S(function));
+  spec.emplace("function_id", Value::S(function));
+  spec.emplace("args_payload", Value::Bin(xlang_payload(std::move(args))));
+  spec.emplace("deps", Value::A(Array{}));
+  spec.emplace("returns", Value::A(std::move(returns)));
+  spec.emplace("resources", Value::M(std::move(resources)));
+  spec.emplace("strategy", Value::M(std::move(strategy)));
+  spec.emplace("max_retries", Value::I(0));
+  spec.emplace("retry_exceptions", Value::B(false));
+  spec.emplace("holder", Value::S(client_id_));
+  spec.emplace("xlang", Value::B(true));
+  return spec;
+}
+
+std::string Session::SubmitTask(const std::string& function, Array args,
+                                double num_cpus) {
+  std::string task_id = NewTaskId();
+  Map spec = TaskSpec(task_id, function, std::move(args), num_cpus);
+  Map p;
+  p.emplace("spec", Value::M(std::move(spec)));
+  Value resp = agent_.Call("submit_task", std::move(p));
+  const Value* acc = resp.get("accepted");
+  if (acc == nullptr || !acc->b)
+    throw std::runtime_error("submit_task rejected for " + function);
+  return task_id + "00000001";
+}
+
+std::string Session::CreateActor(const std::string& class_descriptor,
+                                 Array args, const std::string& name,
+                                 double num_cpus, int max_restarts) {
+  // ActorID.of: 8 random + 4 job; creation TaskID: 8 zero + actor id
+  std::string actor_id = random_hex(16) + job_hex(job_);
+  std::string task_id = std::string(16, '0') + actor_id;
+  Map spec = TaskSpec(task_id, class_descriptor, std::move(args), num_cpus);
+  spec.emplace("actor_id", Value::S(actor_id));
+  spec.emplace("max_concurrency", Value::I(1));
+  spec.emplace("max_restarts", Value::I(max_restarts));
+  Map p;
+  p.emplace("spec", Value::M(std::move(spec)));
+  p.emplace("class_name", Value::S(class_descriptor));
+  p.emplace("name", Value::S(name));
+  p.emplace("namespace", Value::S("default"));
+  p.emplace("max_restarts", Value::I(max_restarts));
+  gcs_.Call("create_actor", std::move(p), 60.0);
+  return actor_id;
+}
+
+std::string Session::ActorCall(const std::string& actor_id,
+                               const std::string& method, Array args,
+                               double timeout_s) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  ActorRoute& route = actors_[actor_id];
+  if (!route.conn) {
+    for (;;) {
+      Map q;
+      q.emplace("actor_id", Value::S(actor_id));
+      Value rec = gcs_.Call("get_actor", std::move(q));
+      if (rec.is_nil())
+        throw std::runtime_error("unknown actor " + actor_id);
+      const std::string& state = rec.get("state")->as_str();
+      if (state == "ALIVE") {
+        route.address = rec.get("address")->as_str();
+        break;
+      }
+      if (state == "DEAD") throw std::runtime_error("actor is dead");
+      if (std::chrono::steady_clock::now() > deadline)
+        throw std::runtime_error("actor not ALIVE within deadline");
+      ::usleep(20000);
+    }
+    size_t colon = route.address.rfind(':');
+    route.conn = std::make_shared<Client>(Client::Connect(
+        route.address.substr(0, colon),
+        std::stoi(route.address.substr(colon + 1))));
+  }
+  // TaskID.for_actor_task: 8 random + actor id
+  std::string task_id = random_hex(16) + actor_id;
+  std::string result_id = task_id + "00000001";
+  // pin returns under this session's holder while the call is in flight
+  // (cluster_runtime.submit_actor_task does the same before its push)
+  std::string task_holder = "task:" + task_id + "@" + client_id_;
+  {
+    Map pin;
+    pin.emplace("task_holder", Value::S(task_holder));
+    pin.emplace("deps", Value::A(Array{}));
+    Array rets;
+    rets.push_back(Value::S(result_id));
+    pin.emplace("returns", Value::A(std::move(rets)));
+    pin.emplace("submitter", Value::S(client_id_));
+    pin.emplace("spec", Value::Nil());
+    gcs_.Call("pin_task", std::move(pin));
+  }
+  Map spec;
+  spec.emplace("task_id", Value::S(task_id));
+  spec.emplace("actor_id", Value::S(actor_id));
+  spec.emplace("method", Value::S(method));
+  spec.emplace("name", Value::S(method));
+  spec.emplace("args_payload", Value::Bin(xlang_payload(std::move(args))));
+  spec.emplace("deps", Value::A(Array{}));
+  Array rets;
+  rets.push_back(Value::S(result_id));
+  spec.emplace("returns", Value::A(std::move(rets)));
+  spec.emplace("xlang", Value::B(true));
+  Map p;
+  p.emplace("spec", Value::M(std::move(spec)));
+  // actor method duration is unbounded (Python parity: _push_actor_task
+  // uses timeout=None for the push); timeout_s bounds only the ALIVE
+  // wait/connection above. kMethodTimeoutS is connection-loss insurance.
+  constexpr double kMethodTimeoutS = 86400.0;
+  auto unpin = [&] {
+    // parity with cluster_runtime._push_actor_task's finally: the task pin
+    // must come off even when the push fails, or retried calls leak pinned
+    // result objects for the life of a heartbeating session
+    Map u;
+    Array a;
+    a.push_back(Value::S(result_id));
+    u.emplace("object_ids", Value::A(std::move(a)));
+    u.emplace("holder", Value::S(task_holder));
+    try {
+      gcs_.Call("remove_object_refs", std::move(u));
+    } catch (const std::exception&) {
+    }
+  };
+  try {
+    route.conn->Call("run_actor_task", std::move(p), kMethodTimeoutS);
+  } catch (...) {
+    actors_.erase(actor_id);  // stale route: next call re-resolves
+    unpin();
+    throw;
+  }
+  unpin();
+  return result_id;
+}
+
+Value Session::GetValue(const std::string& object_id, double timeout_s) {
+  std::string payload = agent_.GetObject(object_id, timeout_s);
+  if (payload.size() < 4 || payload.compare(0, 4, "RTXL") != 0)
+    throw std::runtime_error(
+        "object " + object_id +
+        " is not an xlang (RTXL) value — raw bytes via GetObject");
+  return unpack(payload.substr(4));
+}
+
+void Session::Heartbeat() {
+  Map p;
+  p.emplace("holder", Value::S(client_id_));
+  gcs_.Call("holder_heartbeat", std::move(p));
 }
 
 }  // namespace rtpu

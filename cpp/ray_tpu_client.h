@@ -1,27 +1,33 @@
 // ray_tpu C++ client API.
 //
-// Reference capability: cpp/include/ray/api/*.h (the C++ worker API) and
-// gcs/global_state_accessor — a native client for cluster state, KV, and
-// the object plane. This v1 client speaks the framework's native RPC
-// protocol (length-prefixed msgpack frames, ray_tpu/core/rpc.py:6)
-// directly over TCP:
+// Reference capability: cpp/include/ray/api/*.h (the C++ worker API:
+// ray::Task(F).Remote() -> TaskCaller, actor creation/calls below it,
+// api.h:112-124) and gcs/global_state_accessor — a native client for
+// cluster state, KV, the object plane, and cross-language TASK/ACTOR
+// submission. Speaks the framework's native RPC protocol (length-prefixed
+// msgpack frames, ray_tpu/core/rpc.py:6) directly over TCP:
 //
-//   Client gcs = Client::Connect("127.0.0.1", 6379);
-//   gcs.KvPut("k", "v");  gcs.KvGet("k");
-//   auto nodes = gcs.GetNodes();
+//   Client gcs = Client::Connect("127.0.0.1", gcs_port);
 //   Client agent = Client::Connect(host, agent_port);
-//   std::string oid = agent.PutObject(payload);   // chunked ingest
-//   std::string back = agent.GetObject(gcs, oid); // ensure_local + chunks
+//   Session s(gcs, agent);                  // job id + holder identity
+//   // task: a Python worker imports operator.add and runs it
+//   std::string oid = s.SubmitTask("xlang:operator:add",
+//                                  {Value::I(2), Value::I(40)});
+//   Value v = s.GetValue(oid);              // 42
+//   // actor: importable Python class, methods called by name
+//   std::string aid = s.CreateActor("xlang:collections:Counter", {});
+//   std::string rid = s.ActorCall(aid, "update", {...});
 //
-// Object payloads are raw bytes tagged with the framework's serialization
-// header by the caller (Python drivers interop via
-// ray_tpu.core.serialization). Task/actor submission from C++ is a
-// roadmap item — it needs a cross-language function descriptor registry
-// (reference: java/xlang), not just a wire client.
+// Functions/classes are addressed by cross-language descriptor
+// "xlang:<module>:<qualname>" (reference: java/xlang function
+// descriptors); arguments and results travel as msgpack (the RTXL object
+// format, ray_tpu/core/serialization.py xlang_pack), so both sides stay
+// in the cross-language type universe: nil/bool/int/float/str/bin/list/map.
 
 #pragma once
 
 #include <string>
+#include <vector>
 
 #include "msgpack_lite.h"
 
@@ -54,6 +60,7 @@ class Client {
   std::string PutObject(const std::string& payload,
                         size_t chunk_bytes = 4 << 20);
   // Fetch an object's raw bytes (agent pulls cross-node if needed).
+  // Throws on error objects, carrying the remote error text.
   std::string GetObject(const std::string& object_id,
                         double timeout_s = 30.0,
                         size_t chunk_bytes = 4 << 20);
@@ -65,6 +72,62 @@ class Client {
   int fd_ = -1;
   int64_t next_id_ = 1;
   std::string host_;
+};
+
+// ---------------------------------------------------------------------------
+// Session: task/actor frontend (reference: cpp/include/ray/api.h Task(F) ->
+// TaskCaller / actor creation). Owns a job id (from the GCS sequence) and a
+// holder identity for distributed GC; Heartbeat() renews the holder lease
+// for long-lived drivers.
+// ---------------------------------------------------------------------------
+class Session {
+ public:
+  Session(Client& gcs, Client& agent);
+
+  // Submit "xlang:<module>:<qualname>" with msgpack args; returns the
+  // result object id (fetch with GetValue/GetObject).
+  std::string SubmitTask(const std::string& function, Array args,
+                         double num_cpus = 1.0);
+
+  // Create an actor from an importable Python class; returns the actor id
+  // once registered (poll WaitActorAlive before calling, or just call —
+  // ActorCall resolves ALIVE state itself).
+  std::string CreateActor(const std::string& class_descriptor, Array args,
+                          const std::string& name = "",
+                          double num_cpus = 1.0, int max_restarts = 0);
+
+  // Call a method by name; returns the result object id. ``timeout_s``
+  // bounds only actor resolution (ALIVE wait + connect) — method execution
+  // itself is unbounded, like the Python driver's actor pushes.
+  std::string ActorCall(const std::string& actor_id,
+                        const std::string& method, Array args,
+                        double timeout_s = 60.0);
+
+  // Fetch + decode an RTXL (msgpack) object; throws on error objects.
+  Value GetValue(const std::string& object_id, double timeout_s = 30.0);
+
+  // Renew the holder lease (call every few seconds from long-lived drivers
+  // so results pinned by this session aren't reaped).
+  void Heartbeat();
+
+  const std::string& client_id() const { return client_id_; }
+
+ private:
+  std::string NewTaskId();
+  Map TaskSpec(const std::string& task_id, const std::string& function,
+               Array args, double num_cpus);
+
+  Client& gcs_;
+  Client& agent_;
+  std::string client_id_;
+  uint32_t job_ = 0;
+  // per-actor direct connections (the agent is off the actor data path,
+  // like the Python driver's ActorTaskSubmitter-equivalent direct pushes)
+  struct ActorRoute {
+    std::string address;
+    std::shared_ptr<Client> conn;
+  };
+  std::map<std::string, ActorRoute> actors_;
 };
 
 }  // namespace rtpu

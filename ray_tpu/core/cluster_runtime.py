@@ -220,6 +220,11 @@ class ClusterRuntime(CoreRuntime):
                 reader.close()
         if is_error:
             err = value
+            if isinstance(err, dict) and "__rtpu_error__" in err:
+                # cross-language (xlang) error envelope from a non-Python
+                # submitter's task (see worker_main._store_error_returns)
+                raise exc.TaskError(err.get("__rtpu_error__", "?"),
+                                    err.get("message", ""))
             if isinstance(err, exc.TaskError):
                 raise err.as_instanceof_cause()
             raise err

@@ -102,12 +102,25 @@ class WorkerProcess:
     def _load_function(self, function_id: str) -> Any:
         fn = self._fn_cache.get(function_id)
         if fn is None:
-            from ray_tpu.core.worker import global_worker
+            if function_id.startswith("xlang:"):
+                # cross-language descriptor "xlang:<module>:<qualname>"
+                # (reference capability: java/xlang function descriptors —
+                # non-Python frontends submit by importable name instead of
+                # a pickled closure)
+                import importlib
 
-            payload = global_worker().runtime.kv_get(f"fn:{function_id}")
-            if payload is None:
-                raise KeyError(f"function {function_id} not found in GCS KV")
-            fn = cloudpickle.loads(payload)
+                _, module_name, qualname = function_id.split(":", 2)
+                obj = importlib.import_module(module_name)
+                for part in qualname.split("."):
+                    obj = getattr(obj, part)
+                fn = obj
+            else:
+                from ray_tpu.core.worker import global_worker
+
+                payload = global_worker().runtime.kv_get(f"fn:{function_id}")
+                if payload is None:
+                    raise KeyError(f"function {function_id} not found in GCS KV")
+                fn = cloudpickle.loads(payload)
             self._fn_cache[function_id] = fn
         return fn
 
@@ -123,8 +136,12 @@ class WorkerProcess:
         return tuple(resolve(a) for a in args), {k: resolve(v) for k, v in kwargs.items()}
 
     def _store_value(self, object_id: str, value: Any, is_error: bool = False,
-                     collector: Optional[List[Dict[str, Any]]] = None) -> None:
-        payload, refs = serialization.pack(value)
+                     collector: Optional[List[Dict[str, Any]]] = None,
+                     xlang: bool = False) -> None:
+        if xlang:
+            payload, refs = serialization.xlang_pack(value), []
+        else:
+            payload, refs = serialization.pack(value)
         oid = ObjectID.from_hex(object_id)
         if (collector is not None
                 and len(payload) <= config.max_direct_call_object_size):
@@ -188,9 +205,11 @@ class WorkerProcess:
     def _store_returns(self, spec: Dict[str, Any], result: Any,
                        collector: Optional[List[Dict[str, Any]]] = None) -> None:
         returns: List[str] = spec["returns"]
+        xlang = bool(spec.get("xlang"))
         if len(returns) == 1:
             try:
-                self._store_value(returns[0], result, collector=collector)
+                self._store_value(returns[0], result, collector=collector,
+                                  xlang=xlang)
             except FileExistsError:
                 pass  # duplicate execution (at-least-once): result already stored
             return
@@ -208,18 +227,24 @@ class WorkerProcess:
             return
         for r, v in zip(returns, result):
             try:
-                self._store_value(r, v, collector=collector)
+                self._store_value(r, v, collector=collector, xlang=xlang)
             except FileExistsError:
                 pass  # duplicate execution (at-least-once): already stored
 
     def _store_error_returns(self, spec: Dict[str, Any], e: BaseException,
                              collector: Optional[List[Dict[str, Any]]] = None) -> None:
-        err = exc.TaskError.from_exception(
+        err: Any = exc.TaskError.from_exception(
             e, spec.get("name", "?"), pid=os.getpid(), node_id=self.node_hex
         )
+        xlang = bool(spec.get("xlang"))
+        if xlang:
+            # cross-language error envelope: msgpack-able, recognized by
+            # cluster_runtime._read_local AND the C++ client's is_error path
+            err = {"__rtpu_error__": type(e).__name__, "message": str(err)}
         for r in spec["returns"]:
             try:
-                self._store_value(r, err, is_error=True, collector=collector)
+                self._store_value(r, err, is_error=True, collector=collector,
+                                  xlang=xlang)
             except FileExistsError:
                 pass
         if spec.get("streaming") and spec.get("returns"):

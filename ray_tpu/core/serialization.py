@@ -140,6 +140,37 @@ def packed_size(value: Any) -> int:
     return len(payload)
 
 
+# ---------------------------------------------------------------------------
+# Cross-language (xlang) object format: C++/other-language clients cannot
+# produce or parse pickle, so xlang tasks exchange values as
+# [4-byte magic][msgpack body] (reference capability: java/xlang cross-
+# language serialization — realized with msgpack, the wire format the rest
+# of this runtime already speaks). Discriminator safety: a real packed
+# object starts with u32 n_buffers, and "RTXL" would decode to ~1.3e9
+# buffers, which no legitimate payload has.
+# ---------------------------------------------------------------------------
+XLANG_MAGIC = b"RTXL"
+
+
+def xlang_pack(value: Any) -> bytes:
+    """msgpack-encode a plain value (None/bool/int/float/str/bytes/list/
+    dict). Raises TypeError for anything richer — xlang results must stay in
+    the cross-language type universe."""
+    import msgpack
+
+    try:
+        return XLANG_MAGIC + msgpack.packb(value, use_bin_type=True)
+    except (TypeError, ValueError) as e:
+        raise TypeError(
+            f"xlang task result must be msgpack-serializable "
+            f"(got {type(value).__name__}): {e}"
+        ) from None
+
+
+def is_xlang_payload(payload: memoryview | bytes) -> bool:
+    return bytes(payload[:4]) == XLANG_MAGIC
+
+
 def unpack(payload: memoryview | bytes, zero_copy: bool = True) -> Any:
     """Reconstruct a value from a framed payload.
 
@@ -147,6 +178,10 @@ def unpack(payload: memoryview | bytes, zero_copy: bool = True) -> Any:
     alias the store buffer (read-only), like plasma's zero-copy gets.
     """
     view = memoryview(payload)
+    if is_xlang_payload(view):
+        import msgpack
+
+        return msgpack.unpackb(bytes(view[4:]), raw=False, strict_map_key=False)
     n_buffers, meta_len = struct.unpack_from("<IQ", view, 0)
     off = 12
     lengths = []

@@ -9,10 +9,13 @@ from ray_tpu.data.read_api import (
     range_tensor,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
@@ -28,8 +31,11 @@ __all__ = [
     "range_tensor",
     "read_binary_files",
     "read_csv",
+    "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",
     "read_text",
+    "read_tfrecords",
+    "read_webdataset",
 ]

@@ -16,16 +16,55 @@ Block = pa.Table
 Batch = Union[Dict[str, np.ndarray], pa.Table, "pd.DataFrame"]  # noqa: F821
 
 
+class _PyObjType(pa.ExtensionType):
+    """Arbitrary-python-object column: per-row cloudpickle over binary
+    storage (reference analogue: ArrowPythonObjectArray extension in
+    python/ray/air/util/object_extensions). Carries ragged tensors, mixed
+    types, and anything arrow has no native layout for."""
+
+    def __init__(self) -> None:
+        super().__init__(pa.binary(), "ray_tpu.pyobj")
+
+    def __arrow_ext_serialize__(self) -> bytes:
+        return b""
+
+    @classmethod
+    def __arrow_ext_deserialize__(cls, storage_type, serialized):
+        return cls()
+
+
+_PYOBJ_TYPE = _PyObjType()
+try:
+    pa.register_extension_type(_PYOBJ_TYPE)
+except pa.ArrowKeyError:  # re-import (e.g. tests reloading the module)
+    pass
+
+
+def _pyobj_column(values: Any) -> pa.Array:
+    import cloudpickle
+
+    storage = pa.array([cloudpickle.dumps(v) for v in values], pa.binary())
+    return pa.ExtensionArray.from_storage(_PYOBJ_TYPE, storage)
+
+
 def _normalize_column(values: Any) -> pa.Array:
     if isinstance(values, pa.Array):
         return values
-    arr = np.asarray(values)
+    try:
+        arr = np.asarray(values)
+    except ValueError:  # ragged tensors: per-row shapes differ
+        return _pyobj_column(values)
     if arr.ndim > 1:
-        # tensor column: fixed-size lists
-        flat = arr.reshape(len(arr), -1)
-        return pa.FixedSizeListArray.from_arrays(
-            pa.array(flat.ravel()), flat.shape[1]
-        )
+        if arr.dtype == object:
+            return _pyobj_column(values)
+        # tensor column: shape-preserving canonical arrow extension
+        return pa.FixedShapeTensorArray.from_numpy_ndarray(
+            np.ascontiguousarray(arr))
+    if arr.dtype == object:
+        try:
+            return pa.array(values)  # str/bytes/None/uniform dicts
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            return _pyobj_column(values)
     return pa.array(arr)
 
 
@@ -44,13 +83,22 @@ def block_from_batch(batch: Batch) -> Block:
     raise TypeError(f"cannot convert {type(batch).__name__} to a block")
 
 
-def block_from_rows(rows: List[Any]) -> Block:
+def block_from_rows(rows: List[Any], object_columns: Optional[set] = None) -> Block:
+    """``object_columns``: column names forced to the pyobj layout even when
+    this block's values happen to be uniform — readers whose per-row shapes
+    vary GLOBALLY (e.g. native-shape images) must not let a coincidentally-
+    uniform block become a tensor column, or blocks get incompatible schemas
+    and concat/iter_batches fails."""
     if rows and isinstance(rows[0], dict):
         cols: Dict[str, list] = {}
         for r in rows:
             for k, v in r.items():
                 cols.setdefault(k, []).append(v)
-        return pa.table({k: _normalize_column(v) for k, v in cols.items()})
+        return pa.table({
+            k: (_pyobj_column(v) if object_columns and k in object_columns
+                else _normalize_column(v))
+            for k, v in cols.items()
+        })
     return pa.table({"item": _normalize_column(rows)})
 
 
@@ -80,15 +128,7 @@ class BlockAccessor:
     def to_numpy(self) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {}
         for name in self.block.column_names:
-            col = self.block.column(name)
-            if pa.types.is_fixed_size_list(col.type):
-                combined = col.combine_chunks()
-                if isinstance(combined, pa.ChunkedArray):
-                    combined = combined.chunk(0)
-                values = combined.values.to_numpy(zero_copy_only=False)
-                out[name] = values.reshape(len(col), -1)
-            else:
-                out[name] = col.to_numpy(zero_copy_only=False)
+            out[name] = _column_to_numpy(self.block.column(name))
         return out
 
     def to_pandas(self):
@@ -104,8 +144,61 @@ class BlockAccessor:
         raise ValueError(f"unknown batch_format {batch_format!r}")
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        names = self.block.column_names
+        # tensor columns: one bulk decode wins; pyobj columns: decode rows
+        # LAZILY so take(1) doesn't unpickle a whole block
+        tensors, pyobj = {}, {}
+        for name in names:
+            col = self.block.column(name)
+            if isinstance(col.type, _PyObjType):
+                storage = (col.combine_chunks()
+                           if isinstance(col, pa.ChunkedArray) else col).storage
+                pyobj[name] = storage
+            elif _is_special_type(col.type):
+                tensors[name] = _column_to_numpy(col)
+        import cloudpickle
+
+        def cell(name: str, i: int) -> Any:
+            if name in tensors:
+                return tensors[name][i]
+            if name in pyobj:
+                return cloudpickle.loads(pyobj[name][i].as_py())
+            return self.block.column(name)[i].as_py()
+
         for i in range(self.block.num_rows):
-            yield {name: self.block.column(name)[i].as_py() for name in self.block.column_names}
+            yield {name: cell(name, i) for name in names}
+
+
+def _is_special_type(t: pa.DataType) -> bool:
+    return isinstance(t, (pa.FixedShapeTensorType, _PyObjType)) or (
+        pa.types.is_fixed_size_list(t)
+    )
+
+
+def _column_to_numpy(col) -> np.ndarray:
+    """ChunkedArray/Array -> numpy, decoding tensor + pyobj extensions."""
+    t = col.type
+    if isinstance(t, pa.FixedShapeTensorType):
+        combined = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        if isinstance(combined, pa.ChunkedArray):  # empty table edge
+            return np.zeros((0,) + tuple(t.shape))
+        return combined.to_numpy_ndarray()
+    if isinstance(t, _PyObjType):
+        import cloudpickle
+
+        storage = (col.combine_chunks() if isinstance(col, pa.ChunkedArray)
+                   else col).storage
+        out = np.empty(len(storage), dtype=object)
+        for i, v in enumerate(storage):
+            out[i] = cloudpickle.loads(v.as_py())
+        return out
+    if pa.types.is_fixed_size_list(t):  # legacy flat-tensor layout
+        combined = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        if isinstance(combined, pa.ChunkedArray):
+            combined = combined.chunk(0)
+        values = combined.values.to_numpy(zero_copy_only=False)
+        return values.reshape(len(col), -1)
+    return col.to_numpy(zero_copy_only=False)
 
 
 def concat_blocks(blocks: List[Block]) -> Block:

@@ -205,3 +205,144 @@ def read_binary_files(paths, **kwargs) -> Dataset:
         return thunk
 
     return _parallel_read([make(f) for f in files], "binary")
+
+
+IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tif", ".tiff")
+
+
+def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
+                include_paths: bool = False, files_per_block: int = 64,
+                **kwargs) -> Dataset:
+    """Decode image files into an "image" tensor column (reference:
+    read_images / _internal/datasource/image_datasource.py). ``size=(h, w)``
+    resizes (bilinear) so blocks stack into one [N, h, w, C] tensor — the
+    shape contract the BASELINE image-pipeline → TPU config needs; without
+    ``size`` images keep native shapes (object column)."""
+    files = _expand_paths(paths, IMAGE_SUFFIXES)
+    groups = _chunks(files, files_per_block)
+
+    def make(group):
+        def thunk():
+            from PIL import Image
+
+            arrays, names = [], []
+            for f in group:
+                img = Image.open(f)
+                if mode:
+                    img = img.convert(mode)
+                if size is not None:
+                    img = img.resize((size[1], size[0]), Image.BILINEAR)
+                arrays.append(np.asarray(img))
+                names.append(f)
+            if size is not None:
+                batch = {"image": np.stack(arrays)}
+                if include_paths:
+                    batch["path"] = np.asarray(names, dtype=object)
+                return block_from_batch(batch)
+            rows = [{"image": a} for a in arrays]
+            if include_paths:
+                for r, f in zip(rows, names):
+                    r["path"] = f
+            # native shapes: ALWAYS the pyobj layout — a coincidentally
+            # shape-uniform block would otherwise become a tensor column
+            # with a schema incompatible with its sibling blocks
+            return block_from_rows(rows, object_columns={"image"})
+
+        return thunk
+
+    return _parallel_read([make(g) for g in groups], "images")
+
+
+def _chunks(seq: List[Any], n: int) -> List[List[Any]]:
+    import builtins
+
+    return [seq[i : i + n] for i in builtins.range(0, len(seq), n)]
+
+
+def read_tfrecords(paths, *, verify_crc: bool = False, **kwargs) -> Dataset:
+    """TFRecord files of tf.train.Example records (reference: read_tfrecords
+    / tfrecords_datasource.py), decoded by the native wire codec in
+    ray_tpu/data/tfrecord.py — no TensorFlow dependency. Scalar features
+    unwrap to scalars; multi-value features stay lists."""
+    files = _expand_paths(paths, (".tfrecord", ".tfrecords"))
+
+    def make(f):
+        def thunk():
+            from ray_tpu.data.tfrecord import decode_example, read_records
+
+            rows = []
+            for payload in read_records(f, verify_crc=verify_crc):
+                row = {}
+                for name, values in decode_example(payload).items():
+                    row[name] = values[0] if len(values) == 1 else values
+                rows.append(row)
+            return block_from_rows(rows)
+
+        return thunk
+
+    return _parallel_read([make(f) for f in files], "tfrecords")
+
+
+def read_webdataset(paths, *, decode: bool = True, **kwargs) -> Dataset:
+    """WebDataset tar archives (reference: read_webdataset /
+    webdataset_datasource.py): members sharing a basename form one sample;
+    extensions become columns. With ``decode=True``, jpg/png decode to
+    arrays, ``.cls`` to int, ``.json`` to dicts, ``.txt`` to str, ``.npy``
+    to arrays; unknown extensions stay raw bytes."""
+    files = _expand_paths(paths, (".tar",))
+
+    def decode_member(ext: str, data: bytes) -> Any:
+        if not decode:
+            return data
+        if ext in ("jpg", "jpeg", "png", "bmp", "webp"):
+            import io
+
+            from PIL import Image
+
+            return np.asarray(Image.open(io.BytesIO(data)))
+        if ext == "cls":
+            return int(data)
+        if ext == "json":
+            import json
+
+            return json.loads(data)
+        if ext == "txt":
+            return data.decode()
+        if ext == "npy":
+            import io
+
+            return np.load(io.BytesIO(data), allow_pickle=False)
+        return data
+
+    def make(f):
+        def thunk():
+            import tarfile
+
+            samples: Dict[str, Dict[str, Any]] = {}
+            order: List[str] = []
+            with tarfile.open(f) as tar:
+                for member in tar:
+                    if not member.isfile():
+                        continue
+                    # WebDataset convention: key = full member path up to the
+                    # FIRST dot of the basename (directories stay part of the
+                    # key, so train/0001.* and val/0001.* are distinct samples)
+                    dirname, base = os.path.split(member.name.lstrip("./"))
+                    stem, _dot, ext = base.partition(".")
+                    key = os.path.join(dirname, stem) if dirname else stem
+                    if key not in samples:
+                        samples[key] = {"__key__": key}
+                        order.append(key)
+                    data = tar.extractfile(member).read()
+                    samples[key][ext.lower()] = decode_member(ext.lower(), data)
+            rows = [samples[k] for k in order]
+            # decoded images vary in shape globally: force pyobj layout for
+            # any column holding ndarrays (same schema-stability argument as
+            # read_images without size)
+            nd_cols = {k for r in rows for k, v in r.items()
+                       if isinstance(v, np.ndarray)}
+            return block_from_rows(rows, object_columns=nd_cols or None)
+
+        return thunk
+
+    return _parallel_read([make(f) for f in files], "webdataset")

@@ -9,7 +9,8 @@ axis vocabulary used across models/train/serve:
     dp    data parallel (pure replica)
     fsdp  data parallel with parameter sharding (ZeRO-3 style)
     tp    tensor (megatron) parallel — inside a host's ICI domain ideally
-    sp    sequence parallel for norms/residuals (rides the tp axis)
+    sp    Ulysses sequence parallel (all-to-all head scattering;
+          parallel/ulysses.py) — also reusable for norm/residual SP
     cp    context parallel (ring attention over sequence)
     ep    expert parallel (MoE)
     pp    pipeline parallel (stages)
@@ -26,7 +27,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "cp", "tp")
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "cp", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -35,6 +36,7 @@ class MeshConfig:
     fsdp: int = 1
     tp: int = 1
     cp: int = 1
+    sp: int = 1
     ep: int = 1
     pp: int = 1
     # ---- multi-slice (DCN) factors --------------------------------------
@@ -50,12 +52,13 @@ class MeshConfig:
     def axis_sizes(self) -> Dict[str, int]:
         """LOGICAL axis sizes (dcn factors folded into pp/dp)."""
         return {"pp": self.pp * self.dcn_pp, "dp": self.dp * self.dcn_dp,
-                "fsdp": self.fsdp, "ep": self.ep, "cp": self.cp, "tp": self.tp}
+                "fsdp": self.fsdp, "ep": self.ep, "cp": self.cp,
+                "sp": self.sp, "tp": self.tp}
 
     def slice_axis_sizes(self) -> Dict[str, int]:
         """Per-slice (ICI) axis sizes."""
         return {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
-                "ep": self.ep, "cp": self.cp, "tp": self.tp}
+                "ep": self.ep, "cp": self.cp, "sp": self.sp, "tp": self.tp}
 
     @property
     def num_slices(self) -> int:
@@ -63,7 +66,7 @@ class MeshConfig:
 
     @property
     def devices_per_slice(self) -> int:
-        return self.pp * self.dp * self.fsdp * self.ep * self.cp * self.tp
+        return self.pp * self.dp * self.fsdp * self.ep * self.cp * self.sp * self.tp
 
     @property
     def num_devices(self) -> int:
@@ -78,13 +81,14 @@ class MeshConfig:
             )
 
     @classmethod
-    def auto(cls, n_devices: int, tp: int = 1, cp: int = 1, ep: int = 1, pp: int = 1) -> "MeshConfig":
+    def auto(cls, n_devices: int, tp: int = 1, cp: int = 1, sp: int = 1,
+             ep: int = 1, pp: int = 1) -> "MeshConfig":
         """Fill the leftover factor into fsdp (the usual default for LLM
-        pretraining: FSDP over everything not used by tp/cp/ep/pp)."""
-        used = tp * cp * ep * pp
+        pretraining: FSDP over everything not used by tp/cp/sp/ep/pp)."""
+        used = tp * cp * sp * ep * pp
         if n_devices % used:
-            raise ValueError(f"{n_devices} devices not divisible by tp*cp*ep*pp={used}")
-        return cls(dp=1, fsdp=n_devices // used, tp=tp, cp=cp, ep=ep, pp=pp)
+            raise ValueError(f"{n_devices} devices not divisible by tp*cp*sp*ep*pp={used}")
+        return cls(dp=1, fsdp=n_devices // used, tp=tp, cp=cp, sp=sp, ep=ep, pp=pp)
 
 
 def mesh_shape_for(config: MeshConfig) -> Tuple[Tuple[str, int], ...]:
@@ -169,9 +173,13 @@ def _hybrid_mesh_array(config: MeshConfig, devs,
     # ICI-major under xla_force_host_platform_device_count)
     arr = np.asarray(devs).reshape(
         (config.dcn_pp, config.dcn_dp) + ici_shape)
-    # (dcn_pp, dcn_dp, pp, dp, fsdp, ep, cp, tp)
-    #   -> (dcn_pp, pp, dcn_dp, dp, fsdp, ep, cp, tp) -> merge dcn into axes
-    arr = arr.transpose(0, 2, 1, 3, 4, 5, 6, 7)
+    # (dcn_pp, dcn_dp, *ICI axes) -> (dcn_pp, pp, dcn_dp, dp, *rest):
+    # each dcn factor moves adjacent-outer to its logical ICI axis, then the
+    # pairs merge (dcn-major ordering = contiguous virtual slices)
+    pp_pos = 2 + AXIS_ORDER.index("pp")
+    dp_pos = 2 + AXIS_ORDER.index("dp")
+    rest = [i for i in range(2, arr.ndim) if i not in (pp_pos, dp_pos)]
+    arr = arr.transpose([0, pp_pos, 1, dp_pos] + rest)
     logical = config.axis_sizes()
     return arr.reshape(tuple(logical[n] for n in AXIS_ORDER))
 

@@ -1,7 +1,11 @@
-"""C++ client API integration (reference: cpp/ worker API +
+"""C++ client API integration (reference: cpp/ worker API,
+cpp/include/ray/api.h:112-124 Task(F)/actor creation +
 global_state_accessor): builds cpp/demo against the native msgpack-RPC
 protocol and runs it against a live cluster — KV roundtrip, node/state
-queries, and a chunked 1MB object put/get through the agent."""
+queries, a chunked 1MB object put/get through the agent, and the xlang
+task/actor frontend (C++ submits by "xlang:<module>:<qualname>"
+descriptor, a PYTHON worker executes, C++ fetches the msgpack result;
+remote exceptions propagate as C++ exceptions)."""
 
 import os
 import shutil
@@ -24,9 +28,14 @@ def test_cpp_client_demo_roundtrip():
     try:
         host, port = c.gcs_address.rsplit(":", 1)
         out = subprocess.run([os.path.join(CPP_DIR, "demo"), host, port],
-                             capture_output=True, text=True, timeout=90)
+                             capture_output=True, text=True, timeout=180)
         assert "CPP-DEMO-OK" in out.stdout, (out.stdout, out.stderr)
         assert "object roundtrip ok" in out.stdout
+        # xlang task/actor frontend: Python worker ran operator.add and a
+        # collections.Counter actor on behalf of the C++ driver
+        assert "task roundtrip ok (operator.add -> 42)" in out.stdout
+        assert "task error propagation ok" in out.stdout
+        assert "actor roundtrip ok (Counter.total -> 3)" in out.stdout
     finally:
         c.shutdown()
 

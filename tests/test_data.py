@@ -270,3 +270,111 @@ def test_zip_row_count_mismatch_raises():
     right = rd.from_items([{"b": i} for i in range(6)])
     with pytest.raises(ValueError):
         left.zip(right).take_all()
+
+
+class TestNewReaders:
+    """read_images / read_tfrecords / read_webdataset (VERDICT r4 #8)."""
+
+    def test_read_images(self, tmp_path):
+        from PIL import Image
+
+        for i in range(6):
+            arr = np.full((10 + i, 8, 3), i * 20, dtype=np.uint8)
+            Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+        # native shapes: object rows
+        ds = rd.read_images(str(tmp_path), include_paths=True)
+        rows = ds.take_all()
+        assert len(rows) == 6
+        shapes = sorted(r["image"].shape[0] for r in rows)
+        assert shapes == [10, 11, 12, 13, 14, 15]
+        # resized: stacked tensor batches feedable to a model
+        ds2 = rd.read_images(str(tmp_path), size=(16, 16))
+        batch = next(iter(ds2.iter_batches(batch_size=6)))
+        assert batch["image"].shape == (6, 16, 16, 3)
+        assert batch["image"].dtype == np.uint8
+
+    def test_read_images_uniform_blocks_differing_globally(self, tmp_path):
+        """Per-block-uniform but globally-varying shapes must still produce
+        compatible block schemas (object column), not per-block tensors."""
+        from PIL import Image
+
+        for i in range(4):
+            Image.fromarray(np.zeros((10, 8, 3), np.uint8)).save(
+                tmp_path / f"a{i}.png")
+        for i in range(4):
+            Image.fromarray(np.zeros((12, 8, 3), np.uint8)).save(
+                tmp_path / f"b{i}.png")
+        ds = rd.read_images(str(tmp_path), files_per_block=4)
+        batch = next(iter(ds.iter_batches(batch_size=8)))
+        shapes = sorted(a.shape[0] for a in batch["image"])
+        assert shapes == [10, 10, 10, 10, 12, 12, 12, 12]
+
+    def test_read_webdataset_directory_keys_stay_distinct(self, tmp_path):
+        import io
+        import tarfile
+
+        tar_path = str(tmp_path / "s.tar")
+        with tarfile.open(tar_path, "w") as tar:
+            for split in ("train", "val"):
+                payload = split.encode()
+                info = tarfile.TarInfo(f"{split}/0001.txt")
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+        rows = rd.read_webdataset(tar_path).take_all()
+        assert len(rows) == 2
+        assert {r["__key__"] for r in rows} == {"train/0001", "val/0001"}
+
+    def test_read_tfrecords(self, tmp_path):
+        from ray_tpu.data.tfrecord import write_tfrecords
+
+        f1 = str(tmp_path / "a.tfrecord")
+        f2 = str(tmp_path / "b.tfrecord")
+        write_tfrecords(f1, [{"label": i, "name": f"x{i}".encode(),
+                              "emb": [float(i), float(i) * 0.5]} for i in range(4)])
+        write_tfrecords(f2, [{"label": 9, "name": b"y", "emb": [9.0, 4.5]}])
+        rows = rd.read_tfrecords([f1, f2]).take_all()
+        assert len(rows) == 5
+        by_label = {r["label"]: r for r in rows}
+        assert by_label[2]["name"] == b"x2"
+        assert by_label[9]["emb"][1] == pytest.approx(4.5)
+
+    def test_read_tfrecords_verify_crc_catches_corruption(self, tmp_path):
+        from ray_tpu.data.tfrecord import write_tfrecords
+
+        f = str(tmp_path / "c.tfrecord")
+        write_tfrecords(f, [{"label": 1}])
+        data = bytearray(open(f, "rb").read())
+        data[-5] ^= 0xFF  # flip a payload byte
+        open(f, "wb").write(bytes(data))
+        with pytest.raises(Exception):
+            rd.read_tfrecords(f, verify_crc=True).take_all()
+
+    def test_read_webdataset(self, tmp_path):
+        import io
+        import json
+        import tarfile
+
+        from PIL import Image
+
+        tar_path = str(tmp_path / "shard0.tar")
+        with tarfile.open(tar_path, "w") as tar:
+            for i in range(3):
+                img = Image.fromarray(np.full((4, 4, 3), i, dtype=np.uint8))
+                buf = io.BytesIO()
+                img.save(buf, format="PNG")
+
+                def add(name, payload):
+                    info = tarfile.TarInfo(name)
+                    info.size = len(payload)
+                    tar.addfile(info, io.BytesIO(payload))
+
+                add(f"sample{i}.png", buf.getvalue())
+                add(f"sample{i}.cls", str(i * 10).encode())
+                add(f"sample{i}.json", json.dumps({"idx": i}).encode())
+        rows = rd.read_webdataset(tar_path).take_all()
+        assert len(rows) == 3
+        rows.sort(key=lambda r: r["cls"])
+        assert [r["cls"] for r in rows] == [0, 10, 20]
+        assert rows[1]["png"].shape == (4, 4, 3)
+        assert rows[2]["json"]["idx"] == 2
+        assert rows[2]["__key__"] == "sample2"
