@@ -172,6 +172,22 @@ class Cluster:
             client.close()
 
     def shutdown(self) -> None:
+        # collect THIS cluster's node ids BEFORE killing the GCS: the shm
+        # sweep below must only touch files keyed by our own nodes — a
+        # blanket rtpu-* sweep deletes the arenas of OTHER live clusters on
+        # the box (observed: concurrent test runs corrupting each other)
+        prefixes = set()
+        try:
+            from ray_tpu.core.rpc import SyncRpcClient
+
+            gcs = SyncRpcClient(self.gcs_address)
+            try:
+                prefixes = {n["NodeID"][:8]
+                            for n in gcs.call("get_nodes", timeout=2.0)}
+            finally:
+                gcs.close()
+        except Exception:  # noqa: BLE001 - GCS already dead: leak, don't nuke
+            pass
         for node in self.nodes:
             node.kill()
         if self._gcs_proc is not None:
@@ -184,10 +200,10 @@ class Cluster:
                     pass
         time.sleep(0.1)
         shutil.rmtree(self.session_dir, ignore_errors=True)
-        # best-effort shm cleanup for segments the agents left behind
+        # best-effort shm cleanup, scoped to our node-id prefixes
         try:
             for name in os.listdir("/dev/shm"):
-                if name.startswith("rtpu-"):
+                if name.startswith("rtpu-") and any(p in name for p in prefixes):
                     try:
                         os.unlink(os.path.join("/dev/shm", name))
                     except OSError:

@@ -29,7 +29,7 @@ from ray_tpu.core.resources import (
     PlacementGroupSchedulingStrategy,
     SpreadSchedulingStrategy,
 )
-from ray_tpu.core.rpc import RpcError, SyncRpcClient
+from ray_tpu.core.rpc import RpcConnectionError, RpcError, SyncRpcClient
 from ray_tpu.core.runtime import CoreRuntime
 from ray_tpu.core.shm_store import ShmReader, ShmWriter, segment_name
 from ray_tpu.core.task_spec import TaskSpec
@@ -115,6 +115,7 @@ class ClusterRuntime(CoreRuntime):
         self._submit_acks: "deque" = deque()
         self._submit_window = 64
         self._submit_lock = threading.Lock()  # user threads may race get()/remote()
+        self._shutting_down = False
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
@@ -609,6 +610,11 @@ class ClusterRuntime(CoreRuntime):
 
     def _actor_client(self, address: str) -> SyncRpcClient:
         with self._lock:
+            if self._shutting_down:
+                # a racing push must not mint a client that shutdown()'s
+                # close sweep has already passed by (it would wait on a
+                # dead cluster with no one left to fail its futures)
+                raise RpcConnectionError("runtime is shut down")
             client = self._actor_clients.get(address)
             if client is None:
                 client = SyncRpcClient(address)
@@ -805,6 +811,8 @@ class ClusterRuntime(CoreRuntime):
 
     def shutdown(self) -> None:
         self._ref_stop.set()
+        with self._lock:
+            self._shutting_down = True
         try:
             self._barrier_submit_acks()
         except Exception:  # noqa: BLE001
@@ -866,23 +874,33 @@ def connect_driver(address: str, namespace: Optional[str] = None,
         runtime.remote_data_plane = True
     else:
         # a driver on another machine cannot mmap the agent's shm — flip to
-        # the proxied data plane automatically. Primary probe is FUNCTIONAL
-        # (the agent's arena file must exist locally; hostnames can collide
-        # across cloned VMs); hostname compare covers the segments backend.
+        # the proxied data plane automatically. The probe is FUNCTIONAL for
+        # BOTH backends: the agent writes a nonce file into its /dev/shm at
+        # startup (agent.rpc_node_info "shm_probe"); only a same-machine
+        # driver can read the matching nonce. Hostname comparison is gone —
+        # cloned VMs share hostnames without sharing /dev/shm (ADVICE r4).
         try:
-            import socket
-
             info = runtime.agent.call("node_info", timeout=10.0)
-            store = info.get("store") or {}
-            if store.get("backend") == "arena":
-                from ray_tpu.core.shm_store import arena_path
+            probe = info.get("shm_probe") or {}
+            local = False
+            path, nonce = probe.get("path"), probe.get("nonce")
+            if path and nonce:
+                try:
+                    with open(path) as f:
+                        local = f.read() == nonce
+                except OSError:
+                    local = False
+            elif "shm_probe" not in info:
+                # pre-probe agent (rolling upgrade): fall back to the arena
+                # file check, else assume local (the historical default)
+                store = info.get("store") or {}
+                if store.get("backend") == "arena":
+                    from ray_tpu.core.shm_store import arena_path
 
-                if not os.path.exists(arena_path(runtime.node_hex)):
-                    runtime.remote_data_plane = True
-            else:
-                agent_host = info.get("hostname")
-                if agent_host and agent_host != socket.gethostname():
-                    runtime.remote_data_plane = True
+                    local = os.path.exists(arena_path(runtime.node_hex))
+                else:
+                    local = True
+            runtime.remote_data_plane = not local
         except Exception:  # noqa: BLE001 - probe is best-effort
             pass
     worker = Worker(runtime, JobID.from_int(job_n), node_id=NodeID.from_hex(head["NodeID"]),

@@ -65,6 +65,8 @@ class GcsServer:
         # actors: actor_id hex -> record
         self.actors: Dict[str, Dict[str, Any]] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}
+        # (node, worker) -> signature of the last broadcast log batch
+        self._log_seq: Dict[Tuple[str, str], Tuple] = {}
         # objects: object_id hex -> {size, locations: set, owner}
         self.objects: Dict[str, Dict[str, Any]] = {}
         # placement groups: pg hex -> {bundles, strategy, name, placement: [node hex]}
@@ -214,9 +216,24 @@ class GcsServer:
         return True
 
     async def rpc_publish_worker_logs(self, node_id: str, worker_id: str,
-                                      lines: List[str]) -> bool:
+                                      lines: List[str],
+                                      seq: Optional[int] = None) -> bool:
         """Rebroadcast one node's new worker-log lines to subscribed drivers
-        (reference: log monitor -> GCS pubsub -> driver stdout)."""
+        (reference: log monitor -> GCS pubsub -> driver stdout).
+
+        ``seq`` is the publisher's file offset BEFORE this batch: the
+        monitor's publish-before-advance retry is at-least-once, so an
+        IDENTICAL re-published batch is dropped (exactly-once for the
+        common lost-reply case). A batch with the same start but MORE lines
+        (the file grew during the retry window) is re-broadcast whole —
+        drivers may then see the first lines twice, but lines are never
+        LOST (at-least-once beats at-most-once for logs)."""
+        if seq is not None:
+            key = (node_id, worker_id)
+            sig = (seq, len(lines), lines[-1] if lines else "")
+            if self._log_seq.get(key) == sig:
+                return True  # identical re-publish: already broadcast
+            self._log_seq[key] = sig
         await self.rpc.publish("worker_logs", {
             "node": node_id, "worker": worker_id, "lines": lines,
         })
@@ -269,6 +286,12 @@ class GcsServer:
         # a held version must always imply a held full view (and a future
         # incarnation must never match this one's version)
         self._node_sync_version.pop(node_id, None)
+        # prune per-worker log dedup state (keys carry the node's 8-hex
+        # prefix) — a churny cluster would otherwise leak one entry per
+        # worker ever started
+        prefix = node_id[:8]
+        for key in [k for k in self._log_seq if k[0] == prefix]:
+            del self._log_seq[key]
         if self._external:
             self._external.remove_node(node_id)
         # drop object locations on that node; wake long-poll waiters so they

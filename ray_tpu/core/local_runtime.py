@@ -52,19 +52,40 @@ class _ObjectEntry:
 
 
 class InProcessStore:
-    """Object table: id -> future(value | error)."""
+    """Object table: id -> future(value | error).
+
+    The lock is an RLock as defense in depth: ``entry()`` allocates while
+    holding it, and although ObjectRef.__del__ no longer does locked work
+    (core/object_ref.py deferred releases), any OTHER finalizer running off
+    a GC triggered inside the critical section must not self-deadlock."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._entries: Dict[ObjectID, _ObjectEntry] = {}
+        self._closed_error: Optional[BaseException] = None
 
     def entry(self, oid: ObjectID, create: bool = True) -> Optional[_ObjectEntry]:
         with self._lock:
             e = self._entries.get(oid)
             if e is None and create:
                 e = _ObjectEntry()
+                if self._closed_error is not None:
+                    # post-shutdown: never hand out a future that nothing
+                    # will ever seal (an executor thread blocked on it would
+                    # wedge interpreter exit via the futures atexit join)
+                    e.future.set_result(_StoredError(self._closed_error))
                 self._entries[oid] = e
             return e
+
+    def close(self, error: BaseException) -> None:
+        """Fail every unsealed entry and poison future ones: shutdown must
+        WAKE all blocked get()/dependency waits (liveness over silence)."""
+        with self._lock:
+            self._closed_error = error
+            entries = list(self._entries.values())
+        for e in entries:
+            if not e.future.done():
+                e.future.set_result(_StoredError(error))
 
     def seal(self, oid: ObjectID, value: Any = None, error: Optional[BaseException] = None) -> None:
         e = self.entry(oid)
@@ -1068,6 +1089,12 @@ class LocalRuntime(CoreRuntime):
             actor.kill()
         self._actors.clear()
         self._pgs.clear()
+        # wake every blocked waiter (get(), _resolve_args, nested task
+        # dependencies): leaving them parked would block interpreter exit —
+        # concurrent.futures' atexit joins ALL executor threads, including
+        # an actor-pool thread stuck resolving an object that will now never
+        # be sealed (observed as a post-suite interpreter hang, r5)
+        self._store.close(exc.RayTpuError("ray_tpu runtime is shut down"))
 
     # ---------------------------------------------------------------------- kv
     _kv: Dict[str, bytes]

@@ -113,6 +113,17 @@ class NodeAgent:
             capacity_bytes=object_store_memory,
             spill_dir=os.path.join(self.session_dir, "spill", self.hex[:8]),
         )
+        # shm-locality nonce (rpc_node_info "shm_probe"): proves a client is
+        # on THIS machine regardless of hostname collisions across clones
+        import uuid as _uuid
+
+        self._shm_probe_nonce = _uuid.uuid4().hex
+        self._shm_probe_path = f"/dev/shm/rtpu-probe-{self.hex[:16]}"
+        try:
+            with open(self._shm_probe_path, "w") as f:
+                f.write(self._shm_probe_nonce)
+        except OSError:  # no usable /dev/shm: direct plane impossible anyway
+            self._shm_probe_path = ""
         # object_id hex -> error flag (mirror of GCS metadata for local objs)
         self.error_objects: Set[str] = set()
         self.gcs: Optional[RpcClient] = None
@@ -333,10 +344,12 @@ class NodeAgent:
                     lines = [l.decode("utf-8", "replace") + suffix for l in raw]
                     worker = os.path.basename(path)[len("worker-"):-len(".log")]
                     # publish BEFORE advancing: a failed publish re-sends the
-                    # batch next tick instead of dropping it
+                    # batch next tick instead of dropping it; seq (= the
+                    # pre-batch offset) lets the GCS drop the duplicate when
+                    # only the REPLY was lost, so drivers see each line once
                     await self.gcs.call(
                         "publish_worker_logs", node_id=self.hex[:8],
-                        worker_id=worker, lines=lines, timeout=5.0,
+                        worker_id=worker, lines=lines, seq=prev, timeout=5.0,
                     )
                     offsets[path] = new_off
             except (RpcConnectionError, RpcError, TimeoutError, OSError):
@@ -2282,6 +2295,12 @@ class NodeAgent:
             "workers": len(self._workers),
             "idle_workers": sum(len(v) for v in self._idle_workers.values()),
             "store": self.store.usage(),
+            # shm-locality probe: a nonce file in THIS machine's /dev/shm.
+            # A driver that can read the nonce shares the agent's shm and may
+            # use the direct data plane; hostname comparison alone misses
+            # cloned VMs with identical hostnames (ADVICE r4)
+            "shm_probe": {"path": self._shm_probe_path,
+                          "nonce": self._shm_probe_nonce},
         }
 
     async def rpc_worker_blocked(self, worker_id: str) -> bool:

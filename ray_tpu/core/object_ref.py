@@ -11,10 +11,23 @@ inside other objects (borrowing, see core/serialization.py).
 from __future__ import annotations
 
 import asyncio
+import collections
 import threading
 from typing import Any, Optional
 
 from ray_tpu.core.ids import ObjectID
+
+# Deferred-release queue. ``__del__`` runs at ARBITRARY points — including
+# inside GC triggered while this very thread holds the ref-counter or
+# object-store lock — so it must never take a lock itself: a non-reentrant
+# lock re-acquired on the same thread wedges the whole process (observed:
+# InProcessStore.entry() -> Future() alloc -> GC -> __del__ -> store.free()
+# self-deadlock; every other thread then piles onto the lock — the r4
+# monolithic-suite hang). deque.append is a single C call with no Python-level
+# locking, which is the entire point; a worker-side drain thread applies the
+# releases (reference posture: _raylet.pyx defers ref removal out of
+# __dealloc__ onto the io thread for the same reason).
+_PENDING_RELEASES: "collections.deque[ObjectID]" = collections.deque()
 
 
 class ObjectRef:
@@ -81,13 +94,11 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __del__(self) -> None:
+        # NO locks, NO imports with side effects here — append only (module
+        # comment above). The drain thread in core/worker.py applies it.
         if getattr(self, "_registered", False):
             try:
-                from ray_tpu.core.worker import global_worker
-
-                w = global_worker()
-                if w is not None:
-                    w.remove_local_ref(self.id)
+                _PENDING_RELEASES.append(self.id)
             except Exception:
                 pass
 

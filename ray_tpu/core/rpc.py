@@ -126,6 +126,14 @@ RETRY_SAFE_METHODS = frozenset({
     "pin_tasks", "remove_object_location",
     "object_info", "object_sizes", "read_chunk", "free_object_everywhere",
     "delete_local_object",
+    # idempotent ensure/wait/push surface: a dropped frame must cost one
+    # attempt window, not the caller's whole deadline (broadcast under 5%
+    # chaos burned 125s on one lost ensure_local request, r5)
+    "ensure_local", "ensure_local_batch", "wait_objects",
+    "wait_object_located", "wait_objects_located", "receive_chunk",
+    "push_object",
+    # publish_worker_logs: seq-deduplicated at the GCS (exactly-once)
+    "publish_worker_logs",
     "add_object_refs", "remove_object_refs", "pin_task", "drop_holder",
     "holder_heartbeat", "object_ref_counts", "put_lineage", "get_lineage",
     "get_actor", "get_actor_spec", "get_named_actor", "list_named_actors",
@@ -231,6 +239,15 @@ class RpcServer:
         if method == "__subscribe__":
             channel = msg["p"]["channel"]
             self._subscribers.setdefault(channel, set()).add(writer)
+            await self._reply(writer, {"i": req_id, "r": True})
+            return
+        if method == "__unsubscribe__":
+            channel = msg["p"]["channel"]
+            subs = self._subscribers.get(channel)
+            if subs is not None:
+                subs.discard(writer)
+                if not subs:
+                    del self._subscribers[channel]
             await self._reply(writer, {"i": req_id, "r": True})
             return
         fn = self._handlers.get(method)
@@ -447,11 +464,35 @@ class RpcClient:
         self._sub_callbacks[channel] = callback
         await self.call("__subscribe__", channel=channel)
 
+    async def unsubscribe(self, channel: str) -> None:
+        """Drop a subscription on both ends (per-call channels — e.g. serve
+        RPC streams — would otherwise accumulate forever)."""
+        self._sub_callbacks.pop(channel, None)
+        try:
+            await self.call("__unsubscribe__", channel=channel, timeout=5.0)
+        except (TimeoutError, RpcConnectionError, RpcError):
+            pass  # server-side set is also swept on disconnect
+
     async def close(self) -> None:
         self._closed = True
         self._user_closed = True
+        # Fail in-flight calls HERE, synchronously: close() must never
+        # return while a caller could still be parked on a pending future —
+        # the read task's finally also does this, but its cancellation only
+        # runs when the loop next schedules it, and SyncRpcClient.close()
+        # stops the loop right after this coroutine (a stranded future
+        # blocked interpreter exit via the futures atexit join, r5).
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(RpcConnectionError("client closed"))
+                fut.exception()  # caller may never retrieve: mark consumed
+        self._pending.clear()
         if self._read_task is not None:
             self._read_task.cancel()
+            try:
+                await self._read_task
+            except BaseException:  # noqa: BLE001 - incl. CancelledError
+                pass
         if self._writer is not None:
             try:
                 self._writer.close()
@@ -498,6 +539,9 @@ class SyncRpcClient:
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
         self._run(self._client.subscribe(channel, callback))
+
+    def unsubscribe(self, channel: str) -> None:
+        self._run(self._client.unsubscribe(channel))
 
     def close(self) -> None:
         try:

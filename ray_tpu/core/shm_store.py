@@ -248,7 +248,7 @@ class ShmObjectStore:
         # offset (crashed-execution recovery): their arena blocks are
         # quarantined for a grace period before re-entering circulation so a
         # late write lands in dead memory, not in another object's bytes
-        self._quarantine: List[Tuple[float, int]] = []
+        self._quarantine: List[Tuple[float, int, int]] = []  # (expiry, offset, size)
         backend = backend or config.object_store_backend
         self._arena = None
         if backend in ("auto", "arena"):
@@ -303,6 +303,21 @@ class ShmObjectStore:
                 keep.append((expiry, off, size))
         self._quarantine = keep
 
+    def _reclaim_quarantine_locked(self) -> bool:
+        """Pressure-driven early reclaim of ONE quarantined block (oldest
+        first). The grace window is defense-in-depth against a crashed
+        writer's late bytes; under memory pressure, dropping it early beats
+        evicting LIVE sealed objects while dead bytes sit idle (a churny
+        delete+put workload near capacity would otherwise thrash or raise
+        ObjectStoreFullError). The header was scrubbed at quarantine time,
+        so readers can never validate into the recycled block."""
+        if not self._quarantine:
+            return False
+        _expiry, off, size = self._quarantine.pop(0)
+        self._arena.free(off)
+        self._used -= size
+        return True
+
     def _alloc_locked(self, oid: ObjectID, size: int) -> int:
         """Arena alloc with fragmentation-driven eviction. Must hold lock.
         _ensure_capacity already freed BUDGET; a fragmented arena can still
@@ -316,7 +331,8 @@ class ShmObjectStore:
             if off >= 0:
                 return off
             if attempts >= config.object_store_full_retries or \
-                    not self._evict_one_locked():
+                    not (self._reclaim_quarantine_locked()
+                         or self._evict_one_locked()):
                 raise ObjectStoreFullError(
                     f"arena fragmented: need {size} contiguous, largest free "
                     f"{self._arena.largest_free()} "
@@ -471,7 +487,8 @@ class ShmObjectStore:
             )
         attempts = 0
         while self._used + size > self.capacity and attempts < config.object_store_full_retries:
-            if not self._evict_one_locked():
+            # dead (quarantined) bytes go before live sealed objects
+            if not self._reclaim_quarantine_locked() and not self._evict_one_locked():
                 break
             attempts += 1
         if self._used + size > self.capacity:

@@ -181,6 +181,58 @@ class Worker:
 _global_worker: Optional[Worker] = None
 _global_lock = threading.Lock()
 
+# ---------------------------------------------------------------------------
+# Deferred-release drain: ObjectRef.__del__ may fire mid-GC while this very
+# thread holds the ref-counter/store lock, so it only appends the id to
+# object_ref._PENDING_RELEASES (lock-free). This thread applies the releases
+# OUTSIDE any caller's critical section (see object_ref.py module comment —
+# this closed the r4 monolithic-suite deadlock).
+# ---------------------------------------------------------------------------
+_drain_started = False
+
+
+def drain_deferred_releases(max_items: int = 100_000) -> int:
+    """Apply queued __del__ releases now. Called by the background drain
+    thread; also useful in tests that assert prompt frees."""
+    from ray_tpu.core.object_ref import _PENDING_RELEASES
+
+    w = _global_worker
+    n = 0
+    while n < max_items:
+        try:
+            oid = _PENDING_RELEASES.popleft()
+        except IndexError:
+            break
+        n += 1
+        if w is None:
+            continue  # shutdown raced: nothing to release against
+        try:
+            w.ref_counter.remove_local(oid)
+        except Exception:  # noqa: BLE001 - releases are best-effort
+            pass
+    return n
+
+
+def _drain_loop() -> None:
+    import time
+
+    while True:
+        time.sleep(0.05)
+        try:
+            drain_deferred_releases()
+        except Exception:  # noqa: BLE001 - the drain must never die
+            pass
+
+
+def _ensure_drain_thread() -> None:
+    global _drain_started
+    with _global_lock:
+        if _drain_started:
+            return
+        _drain_started = True
+    threading.Thread(target=_drain_loop, daemon=True,
+                     name="ref-release-drain").start()
+
 
 def global_worker() -> Optional[Worker]:
     return _global_worker
@@ -195,8 +247,17 @@ def require_worker() -> Worker:
 
 def set_global_worker(worker: Optional[Worker]) -> None:
     global _global_worker
+    if worker is None:
+        # shutdown: apply releases against the OUTGOING worker first, so a
+        # shutdown-then-init sequence can't leak them onto the next runtime
+        try:
+            drain_deferred_releases()
+        except Exception:  # noqa: BLE001
+            pass
     with _global_lock:
         _global_worker = worker
+    if worker is not None:
+        _ensure_drain_thread()
 
 
 def maybe_register_borrowed_ref(ref: "ObjectRef") -> None:

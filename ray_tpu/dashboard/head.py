@@ -73,15 +73,37 @@ class DashboardHead:
             parts = line.decode("latin1").split(" ")
             if len(parts) < 2:
                 return
+            method = parts[0].upper()
             path = parts[1].split("?", 1)[0]
-            while (await reader.readline()).strip():
-                pass  # drain headers (all endpoints are GET)
+            length = 0
+            bad_length = False
+            while True:
+                h = (await reader.readline()).decode("latin1").strip()
+                if not h:
+                    break
+                if ":" in h:
+                    k, v = h.split(":", 1)
+                    if k.strip().lower() == "content-length":
+                        try:
+                            length = int(v.strip() or 0)
+                        except ValueError:
+                            bad_length = True
+            # 16 MiB cap: the dashboard port is unauthenticated — a huge
+            # declared length must not buffer unbounded memory
+            if bad_length or length < 0 or length > 16 << 20:
+                writer.write(b"HTTP/1.1 400 Bad Request\r\nContent-Length: 0"
+                             b"\r\nConnection: close\r\n\r\n")
+                await writer.drain()
+                return
+            req_body = await reader.readexactly(length) if length else b""
             try:
-                status, body, ctype = await self._route(path)
+                status, body, ctype = await self._route(path, method, req_body)
             except Exception as e:  # noqa: BLE001 - surface as 500
                 logger.exception("dashboard handler error for %s", path)
                 status, body, ctype = 500, str(e).encode(), b"text/plain"
-            reason = {200: b"OK", 404: b"Not Found", 500: b"Internal Server Error"}
+            reason = {200: b"OK", 202: b"Accepted", 400: b"Bad Request",
+                      404: b"Not Found", 409: b"Conflict",
+                      500: b"Internal Server Error"}
             writer.write(
                 b"HTTP/1.1 " + str(status).encode() + b" " + reason.get(status, b"") +
                 b"\r\nContent-Type: " + ctype +
@@ -98,7 +120,10 @@ class DashboardHead:
             except Exception:  # noqa: BLE001
                 pass
 
-    async def _route(self, path: str) -> Tuple[int, bytes, bytes]:
+    async def _route(self, path: str, method: str = "GET",
+                     req_body: bytes = b"") -> Tuple[int, bytes, bytes]:
+        if path.startswith("/api/serve/"):
+            return await self._serve_rest(path, method, req_body)
         if path in ("/", "/index.html"):
             return 200, _INDEX_HTML, b"text/html"
         if path == "/-/healthz":
@@ -120,6 +145,59 @@ class DashboardHead:
             return 404, b"not found", b"text/plain"
         body = json.dumps(await api(), default=_jsonable).encode()
         return 200, body, b"application/json"
+
+    # ------------------------------------------------------------- serve rest
+    async def _serve_rest(self, path: str, method: str,
+                          req_body: bytes) -> Tuple[int, bytes, bytes]:
+        """Declarative serve REST (reference: dashboard serve module +
+        serve/schema.py). Validation is pure; apply rides the GCS-KV config
+        bus consumed by the running controller (schema.py module docs)."""
+        from ray_tpu.serve import schema
+
+        if path == "/api/serve/applications" and method == "GET":
+            out: Dict[str, Any] = {}
+            for label, key in (("config", schema.CONFIG_KEY),
+                               ("pending", schema.PENDING_KEY),
+                               ("status", schema.STATUS_KEY)):
+                raw = await self._agent.gcs.call("kv_get", key=key)
+                out[label] = json.loads(raw) if raw else None
+            return 200, json.dumps(out).encode(), b"application/json"
+        if path == "/api/serve/applications" and method == "PUT":
+            try:
+                try:
+                    cfg = json.loads(req_body)
+                except json.JSONDecodeError:
+                    import yaml
+
+                    cfg = yaml.safe_load(req_body)
+                cfg = schema.validate_config(cfg)
+            except Exception as e:  # noqa: BLE001 - client error
+                return 400, f"invalid config: {e}".encode(), b"text/plain"
+            if not await self._serve_running():
+                return 409, (b"no running serve controller - deploy via "
+                             b"'serve deploy' CLI first"), b"text/plain"
+            await self._agent.gcs.call(
+                "kv_put", key=schema.PENDING_KEY,
+                value=json.dumps(cfg).encode())
+            return 202, b"config accepted; controller will reconcile", b"text/plain"
+        if path == "/api/serve/rollback" and method == "POST":
+            prev = await self._agent.gcs.call("kv_get", key=schema.PREV_KEY)
+            if not prev:
+                return 409, b"no previous config to roll back to", b"text/plain"
+            if not await self._serve_running():
+                return 409, b"no running serve controller", b"text/plain"
+            await self._agent.gcs.call(
+                "kv_put", key=schema.ROLLBACK_KEY, value=b"1")
+            return 202, b"rollback accepted", b"text/plain"
+        return 404, b"not found", b"text/plain"
+
+    async def _serve_running(self) -> bool:
+        try:
+            actor_hex = await self._agent.gcs.call(
+                "get_named_actor", name="SERVE_CONTROLLER", namespace="serve")
+            return actor_hex is not None
+        except Exception:  # noqa: BLE001
+            return False
 
     # ------------------------------------------------------------- state api
     async def _nodes(self) -> List[Dict[str, Any]]:

@@ -1,6 +1,13 @@
 from ray_tpu.rl.dqn import DQNConfig, DQNTrainer
 from ray_tpu.rl.env import CartPoleEnv, ChainEnv, make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.impala import (
+    ImpalaConfig,
+    ImpalaTrainer,
+    ac_forward,
+    ac_init,
+    vtrace,
+)
 from ray_tpu.rl.grpo import (
     GRPOConfig,
     compute_group_advantages,
@@ -13,9 +20,10 @@ from ray_tpu.rl.trainer import GRPOTrainer
 
 __all__ = [
     "CartPoleEnv", "ChainEnv", "DQNConfig", "DQNTrainer", "EnvRunner",
-    "EnvRunnerGroup", "GRPOConfig", "GRPOTrainer", "PPOConfig",
-    "PrioritizedReplayBuffer", "ReplayBuffer",
+    "EnvRunnerGroup", "GRPOConfig", "GRPOTrainer", "ImpalaConfig",
+    "ImpalaTrainer", "PPOConfig",
+    "PrioritizedReplayBuffer", "ReplayBuffer", "ac_forward", "ac_init",
     "compute_group_advantages", "gae_advantages",
     "make_env", "make_grpo_step", "make_logprob_fn", "make_ppo_step",
-    "register_env",
+    "register_env", "vtrace",
 ]

@@ -1,0 +1,336 @@
+"""IMPALA: asynchronous off-policy actor-critic with V-trace correction.
+
+Reference capability: rllib/algorithms/impala/impala.py (Espeholt '18 —
+actors stream trajectory unrolls ahead of the learner; the learner corrects
+the resulting off-policyness with V-trace importance weighting). Redesign
+on this runtime's primitives:
+
+- each actor is a ``num_returns="streaming"`` remote GENERATOR
+  (core/streaming.py): it rolls its env forever and yields fixed-length
+  unrolls, with generator backpressure bounding how far a runner can run
+  ahead of the learner — the queue the reference builds from aioqueues
+  falls out of the streaming machinery;
+- behavior-policy logits ride inside each unroll, so the learner computes
+  clipped importance ratios against its CURRENT policy (V-trace rho/c);
+- runners refresh params from the GCS KV every few unrolls (stale-policy
+  lag is the point of IMPALA — V-trace absorbs it);
+- the update is ONE jitted program: forward over the [B, T] batch,
+  V-trace via a backward ``lax.scan``, policy-gradient + value + entropy
+  losses, optax step. TPU-first: batch unrolls, static [B, T] shapes.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import make_env
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("rl.impala")
+
+PARAMS_KEY = "impala:params"
+
+
+@dataclass
+class ImpalaConfig:
+    env: str = "CartPole-rt"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    hidden: tuple = (128, 128)
+    lr: float = 5e-4
+    gamma: float = 0.99
+    unroll_len: int = 32           # T: steps per yielded trajectory piece
+    num_runners: int = 2
+    batch_unrolls: int = 8         # B: unrolls per learner update
+    rho_clip: float = 1.0          # V-trace rho-bar (IS clip for deltas/pg)
+    c_clip: float = 1.0            # V-trace c-bar (trace cutting)
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    param_refresh_unrolls: int = 1  # runner pulls params every N unrolls
+    max_queue_unrolls: int = 8     # backpressure: max unrolls a runner runs ahead
+    seed: int = 0
+
+
+# ------------------------------------------------------------ actor-critic
+def ac_init(obs_dim: int, num_actions: int, hidden, key):
+    import jax
+    import jax.numpy as jnp
+
+    sizes = (obs_dim,) + tuple(hidden)
+    trunk = []
+    for a, b in zip(sizes[:-1], sizes[1:]):
+        key, k = jax.random.split(key)
+        trunk.append({
+            "w": jax.random.normal(k, (a, b), jnp.float32) * (2.0 / a) ** 0.5,
+            "b": jnp.zeros((b,), jnp.float32),
+        })
+    key, k1, k2 = jax.random.split(key, 3)
+    return {
+        "trunk": trunk,
+        "pi": {"w": jax.random.normal(k1, (sizes[-1], num_actions),
+                                      jnp.float32) * 0.01,
+               "b": jnp.zeros((num_actions,), jnp.float32)},
+        "v": {"w": jax.random.normal(k2, (sizes[-1], 1), jnp.float32) * 0.01,
+              "b": jnp.zeros((1,), jnp.float32)},
+    }
+
+
+def ac_forward(params, obs):
+    """obs [..., obs_dim] -> (logits [..., A], value [...])."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(obs, jnp.float32)
+    for layer in params["trunk"]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["v"]["w"] + params["v"]["b"])[..., 0]
+    return logits, value
+
+
+# ----------------------------------------------------------------- V-trace
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap, dones,
+           gamma: float, rho_clip: float, c_clip: float):
+    """All inputs [B, T] (bootstrap [B]). Returns (vs [B,T], pg_adv [B,T]).
+
+    vs_t = V_t + sum_{k>=t} gamma^{k-t} (prod_{i<k} c_i) delta_k,
+    delta_k = rho_k (r_k + gamma V_{k+1} (1-d_k) - V_k), computed with a
+    single backward lax.scan (compiler-friendly, no python loop over T).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_clip)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_clip)
+    not_done = 1.0 - dones
+    v_next = jnp.concatenate([values[:, 1:], bootstrap[:, None]], axis=1)
+    deltas = rho * (rewards + gamma * v_next * not_done - values)
+
+    def backward(acc, xs):
+        delta_t, c_t, nd_t = xs
+        acc = delta_t + gamma * nd_t * c_t * acc
+        return acc, acc
+
+    # scan over time reversed; per-batch handled by vmap-free transpose
+    _, accs = jax.lax.scan(
+        backward,
+        jnp.zeros_like(bootstrap),
+        (deltas.T[::-1], c.T[::-1], not_done.T[::-1]),
+    )
+    vs_minus_v = accs[::-1].T
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate([vs[:, 1:], bootstrap[:, None]], axis=1)
+    pg_adv = rho * (rewards + gamma * vs_next * not_done - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+def make_impala_update(config: ImpalaConfig, optimizer):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        # batch: obs [B,T,O], next_obs_last [B,O], actions [B,T],
+        # rewards [B,T], dones [B,T], behavior_logits [B,T,A]
+        logits, values = ac_forward(params, batch["obs"])
+        _, bootstrap = ac_forward(params, batch["next_obs_last"])
+        logp_all = jax.nn.log_softmax(logits)
+        act = batch["actions"][..., None]
+        target_logp = jnp.take_along_axis(logp_all, act, -1)[..., 0]
+        behavior_logp = jnp.take_along_axis(
+            jax.nn.log_softmax(batch["behavior_logits"]), act, -1)[..., 0]
+        vs, pg_adv = vtrace(
+            behavior_logp, target_logp, batch["rewards"], values,
+            bootstrap, batch["dones"], config.gamma, config.rho_clip,
+            config.c_clip,
+        )
+        pg_loss = -jnp.mean(target_logp * pg_adv)
+        v_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        loss = (pg_loss + config.value_coef * v_loss
+                - config.entropy_coef * entropy)
+        return loss, {"pg_loss": pg_loss, "v_loss": v_loss,
+                      "entropy": entropy,
+                      "mean_rho": jnp.mean(jnp.exp(target_logp - behavior_logp))}
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return update
+
+
+# ---------------------------------------------------------- streaming actor
+def _make_rollout_stream(config: ImpalaConfig):
+    """Returns the streaming remote function: an infinite generator of
+    unrolls. Created per-trainer so backpressure rides the remote options."""
+
+    @ray_tpu.remote(num_returns="streaming",
+                    _generator_backpressure=config.max_queue_unrolls,
+                    name="impala::rollout_stream")
+    def rollout_stream(worker_index: int, num_unrolls: int):
+        import cloudpickle
+        import jax
+        import jax.numpy as jnp
+
+        env = make_env(config.env, **config.env_config)
+        rng = np.random.default_rng(config.seed + worker_index)
+        params = cloudpickle.loads(ray_tpu.kv_get(PARAMS_KEY))
+        fwd = jax.jit(ac_forward)
+        obs, _ = env.reset(seed=config.seed + worker_index)
+        ep_ret, ep_len, completed = 0.0, 0, []
+        for unroll_idx in range(num_unrolls):
+            if unroll_idx % config.param_refresh_unrolls == 0 and unroll_idx:
+                raw = ray_tpu.kv_get(PARAMS_KEY)
+                if raw is not None:
+                    params = cloudpickle.loads(raw)
+            T = config.unroll_len
+            obs_l, act_l, rew_l, done_l, logits_l = [], [], [], [], []
+            for _ in range(T):
+                logits, _v = fwd(params, jnp.asarray(obs[None]))
+                logits = np.asarray(logits[0])
+                # sample from the behavior policy (exploration comes from
+                # the policy's own entropy, kept up by the entropy bonus)
+                z = logits - logits.max()
+                p = np.exp(z) / np.exp(z).sum()
+                action = int(rng.choice(len(p), p=p))
+                nxt, reward, terminated, truncated, _ = env.step(action)
+                obs_l.append(obs)
+                act_l.append(action)
+                rew_l.append(reward)
+                # truncations also cut the trace: the next stored obs is the
+                # RESET state of a new episode, so bootstrapping across the
+                # boundary would leak the wrong episode's value into V-trace
+                # targets (slightly pessimistic near time limits, never biased
+                # by cross-episode leakage)
+                done_l.append(float(terminated or truncated))
+                logits_l.append(logits)
+                ep_ret += reward
+                ep_len += 1
+                if terminated or truncated:
+                    completed.append({"episode_return": ep_ret,
+                                      "episode_len": ep_len})
+                    ep_ret, ep_len = 0.0, 0
+                    obs, _ = env.reset()
+                else:
+                    obs = nxt
+            episodes, completed = completed, []
+            yield {
+                "obs": np.asarray(obs_l, np.float32),
+                "next_obs_last": np.asarray(obs, np.float32),
+                "actions": np.asarray(act_l, np.int64),
+                "rewards": np.asarray(rew_l, np.float32),
+                "dones": np.asarray(done_l, np.float32),
+                "behavior_logits": np.asarray(logits_l, np.float32),
+                "episodes": episodes,
+                "worker_index": worker_index,
+            }
+
+    return rollout_stream
+
+
+class ImpalaTrainer:
+    """Learner loop: consume unroll streams round-robin, batch them, run the
+    jitted V-trace update, publish fresh params to KV. train() returns
+    rllib-style result dicts (+ env_steps_per_s, the IMPALA headline)."""
+
+    def __init__(self, config: ImpalaConfig, total_unrolls_per_runner: int = 10_000):
+        import cloudpickle
+        import jax
+        import optax
+
+        self.config = config
+        probe = make_env(config.env, **config.env_config)
+        self.params = ac_init(probe.obs_dim, probe.num_actions,
+                              config.hidden, jax.random.key(config.seed))
+        self.optimizer = optax.adamw(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_impala_update(config, self.optimizer)
+        ray_tpu.kv_put(PARAMS_KEY, cloudpickle.dumps(
+            jax.device_get(self.params)))
+        stream_fn = _make_rollout_stream(config)
+        self._streams = [
+            iter(stream_fn.remote(i, total_unrolls_per_runner))
+            for i in range(config.num_runners)
+        ]
+        self.iteration = 0
+        self._episode_returns: List[float] = []
+        self._env_steps = 0
+
+    def _next_unrolls(self, n: int, timeout: float = 120.0) -> List[Dict]:
+        """Round-robin pull across runner streams; a finished/failed stream
+        is dropped (remaining runners keep the learner fed — the reference's
+        aggregator keeps sampling through worker failures)."""
+        out: List[Dict] = []
+        while len(out) < n and self._streams:
+            for it in list(self._streams):
+                if len(out) >= n:
+                    break
+                try:
+                    ref = next(it)
+                    out.append(ray_tpu.get(ref, timeout=timeout))
+                except StopIteration:
+                    self._streams.remove(it)
+                except Exception:  # noqa: BLE001 - runner died mid-stream
+                    logger.warning("dropping failed rollout stream",
+                                   exc_info=True)
+                    self._streams.remove(it)
+        if not out:
+            raise RuntimeError("all rollout streams ended")
+        return out
+
+    def train(self) -> Dict[str, Any]:
+        import cloudpickle
+        import jax
+        import numpy as np  # noqa: F811 - jitted closure uses module numpy
+
+        c = self.config
+        t0 = time.perf_counter()
+        unrolls = self._next_unrolls(c.batch_unrolls)
+        batch = {
+            k: np.stack([u[k] for u in unrolls])
+            for k in ("obs", "next_obs_last", "actions", "rewards", "dones",
+                      "behavior_logits")
+        }
+        for u in unrolls:
+            self._episode_returns.extend(
+                e["episode_return"] for e in u["episodes"])
+            self._env_steps += len(u["actions"])
+        self.params, self.opt_state, loss, aux = self._update(
+            self.params, self.opt_state, batch)
+        ray_tpu.kv_put(PARAMS_KEY, cloudpickle.dumps(
+            jax.device_get(self.params)))
+        self.iteration += 1
+        dt = time.perf_counter() - t0
+        recent = self._episode_returns[-20:]
+        return {
+            "training_iteration": self.iteration,
+            "loss": float(loss),
+            "pg_loss": float(aux["pg_loss"]),
+            "v_loss": float(aux["v_loss"]),
+            "entropy": float(aux["entropy"]),
+            "mean_rho": float(aux["mean_rho"]),
+            "env_steps_total": self._env_steps,
+            "env_steps_this_iter": c.batch_unrolls * c.unroll_len,
+            "env_steps_per_s": c.batch_unrolls * c.unroll_len / max(dt, 1e-9),
+            "episode_return_mean": float(np.mean(recent)) if recent else None,
+            "num_episodes": len(self._episode_returns),
+            "time_this_iter_s": dt,
+        }
+
+    def stop(self) -> None:
+        for it in self._streams:
+            try:
+                it.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._streams = []

@@ -283,6 +283,37 @@ def cmd_logs(args) -> None:
     _stream_job_logs(client, args.job_id)
 
 
+def cmd_serve(args) -> None:
+    """`serve deploy/status/rollback` (reference: serve CLI -> schema flow)."""
+    import json
+
+    import ray_tpu
+    from ray_tpu.serve import schema
+
+    ray_tpu.init(address=_resolve_address(args), log_to_driver=False)
+    if args.serve_command == "deploy":
+        cfg = schema.load_yaml(args.config_file)
+        status = schema.apply_config(cfg, wait_for_ready=not args.no_wait)
+        print(json.dumps(status, indent=2))
+        sys.exit(1 if status["errors"] else 0)
+    if args.serve_command == "status":
+        from ray_tpu import serve
+        from ray_tpu.serve import api as serve_api
+
+        out = {"config": schema.current_config()}
+        try:
+            serve_api._state["controller"] = ray_tpu.get_actor(
+                "SERVE_CONTROLLER", namespace="serve")
+            out["applications"] = serve.status()
+        except ValueError:
+            out["applications"] = {}
+        print(json.dumps(out, indent=2, default=str))
+        return
+    if args.serve_command == "rollback":
+        print(json.dumps(schema.rollback(), indent=2))
+        return
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -332,6 +363,20 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--address", default=None)
     p.add_argument("--follow", action="store_true")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("serve", help="declarative serve deploy/status/rollback")
+    serve_sub = p.add_subparsers(dest="serve_command", required=True)
+    sp = serve_sub.add_parser("deploy", help="apply a YAML app config")
+    sp.add_argument("config_file")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--no-wait", action="store_true")
+    sp.set_defaults(fn=cmd_serve)
+    sp = serve_sub.add_parser("status", help="declarative config + app status")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve)
+    sp = serve_sub.add_parser("rollback", help="revert to the previous config")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
     if getattr(args, "cmd", None) and args.cmd and args.cmd[0] == "--":

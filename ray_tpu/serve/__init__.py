@@ -21,10 +21,14 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.rpc_ingress import ServeRpcClient
+from ray_tpu.serve import schema
 
 __all__ = [
+    "ServeRpcClient",
     "get_multiplexed_model_id",
     "multiplexed",
+    "schema",
     "Application",
     "AutoscalingConfig",
     "Deployment",

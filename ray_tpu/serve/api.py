@@ -166,6 +166,10 @@ def shutdown() -> None:
             pass
     for proxy in proxies:
         try:
+            ray_tpu.get(proxy.stop.remote(), timeout=10)  # release the port
+        except Exception:  # noqa: BLE001
+            pass
+        try:
             ray_tpu.kill(proxy)
         except Exception:  # noqa: BLE001
             pass

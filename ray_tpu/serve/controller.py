@@ -75,28 +75,42 @@ class ServeController:
         the control loop (deploy returns once the target is recorded; callers
         poll wait_ready)."""
         dep = cloudpickle.loads(deployment_def)
+        stale: List[Any] = []
         with self._lock:
             old = self._apps.get(app_name)
+            # code/ctor-args change = a new VERSION: existing replicas run
+            # the old code and must be rolled, not reconfigured (reference:
+            # deployment_state.py version-change rolling update). num_replicas
+            # and user_config changes keep replicas in place.
+            code_changed = old is not None and (
+                old["init_args"] != init_args
+                or old["deployment_def"] != deployment_def
+            )
             self._apps[app_name] = {
                 "deployment_def": deployment_def,
                 "deployment": dep,
                 "init_args": init_args,
                 "target": dep.target_replicas,
-                "replicas": old["replicas"] if old else [],
+                "replicas": [] if code_changed else (old["replicas"] if old else []),
                 "next_replica_idx": old["next_replica_idx"] if old else 0,
                 "last_scale_up": 0.0,
                 "last_scale_down": 0.0,
                 "ongoing_history": [],
             }
-            # config-only change (num_replicas / user_config): keep replicas,
-            # reconfigure in place
-            if old is not None:
+            if code_changed:
+                stale = list(old["replicas"])
+            elif old is not None:
                 for r in old["replicas"]:
                     if dep.user_config is not None:
                         try:
                             r.reconfigure.remote(dep.user_config)
                         except Exception:  # noqa: BLE001
                             pass
+        # stale replicas left the routing set with the version bump below;
+        # drain off-thread so their in-flight requests finish first
+        for r in stale:
+            threading.Thread(target=self._drain_then_stop, args=(r,),
+                             daemon=True, name="serve-drain").start()
         self._bump_version()
         return True
 
@@ -184,12 +198,34 @@ class ServeController:
                 logger.exception("serve control loop error")
 
     def _reconcile_once(self) -> None:
+        self._poll_declarative()
         with self._lock:
             apps = list(self._apps.items())
         for name, rec in apps:
             self._health_check(name, rec)
             self._autoscale(name, rec)
             self._scale_to_target(name, rec)
+
+    def _poll_declarative(self) -> None:
+        """Config-bus half of `serve deploy` REST (serve/schema.py): the
+        dashboard validates + enqueues configs/rollback flags in GCS KV; the
+        controller (a full worker process) applies them here — so the REST
+        plane needs no actor plumbing (reference: serve REST -> controller
+        deploy flow, schema.py + application_state.py)."""
+        import json as _json
+
+        from ray_tpu.serve import schema as _schema
+
+        try:
+            raw = ray_tpu.kv_get(_schema.PENDING_KEY)
+            if raw:
+                ray_tpu.kv_del(_schema.PENDING_KEY)
+                _schema.apply_config(_json.loads(raw))
+            if ray_tpu.kv_get(_schema.ROLLBACK_KEY):
+                ray_tpu.kv_del(_schema.ROLLBACK_KEY)
+                _schema.rollback()
+        except Exception:  # noqa: BLE001 - the loop must never die
+            logger.exception("declarative config apply failed")
 
     def _health_check(self, name: str, rec: Dict[str, Any]) -> None:
         dead = []

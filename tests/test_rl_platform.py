@@ -133,3 +133,66 @@ def test_dqn_learns_chain():
         assert result["loss"] is not None
     finally:
         trainer.stop()
+
+
+class TestImpala:
+    """IMPALA (VERDICT r4 #9): streaming env-runners -> V-trace learner."""
+
+    def test_vtrace_matches_bruteforce(self):
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import vtrace
+
+        rng = np.random.default_rng(0)
+        B, T = 3, 7
+        gamma, rho_bar, c_bar = 0.95, 1.0, 1.0
+        blogp = rng.standard_normal((B, T)).astype(np.float32) * 0.3
+        tlogp = rng.standard_normal((B, T)).astype(np.float32) * 0.3
+        rewards = rng.standard_normal((B, T)).astype(np.float32)
+        values = rng.standard_normal((B, T)).astype(np.float32)
+        bootstrap = rng.standard_normal(B).astype(np.float32)
+        dones = (rng.random((B, T)) < 0.2).astype(np.float32)
+        vs, pg = vtrace(jnp.asarray(blogp), jnp.asarray(tlogp),
+                        jnp.asarray(rewards), jnp.asarray(values),
+                        jnp.asarray(bootstrap), jnp.asarray(dones),
+                        gamma, rho_bar, c_bar)
+        # brute force per Espeholt '18 eq. (1), loops over time
+        rho = np.minimum(np.exp(tlogp - blogp), rho_bar)
+        c = np.minimum(np.exp(tlogp - blogp), c_bar)
+        nd = 1.0 - dones
+        v_next = np.concatenate([values[:, 1:], bootstrap[:, None]], 1)
+        deltas = rho * (rewards + gamma * v_next * nd - values)
+        vs_ref = np.zeros_like(values)
+        for b in range(B):
+            acc = 0.0
+            for t in reversed(range(T)):
+                acc = deltas[b, t] + gamma * nd[b, t] * c[b, t] * acc
+                vs_ref[b, t] = values[b, t] + acc
+        np.testing.assert_allclose(np.asarray(vs), vs_ref, rtol=1e-5,
+                                   atol=1e-5)
+        vs_next = np.concatenate([vs_ref[:, 1:], bootstrap[:, None]], 1)
+        pg_ref = rho * (rewards + gamma * vs_next * nd - values)
+        np.testing.assert_allclose(np.asarray(pg), pg_ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_impala_learns_chain_with_throughput(self):
+        from ray_tpu.rl import ImpalaConfig, ImpalaTrainer
+
+        cfg = ImpalaConfig(
+            env="Chain-rt", env_config={"n": 6, "max_steps": 20},
+            hidden=(32,), num_runners=2, unroll_len=20, batch_unrolls=4,
+            entropy_coef=0.02, lr=3e-3, seed=0,
+        )
+        trainer = ImpalaTrainer(cfg, total_unrolls_per_runner=2_000)
+        try:
+            result = {}
+            for _ in range(30):
+                result = trainer.train()
+            assert result["episode_return_mean"] is not None
+            # optimal for n=6/20 steps ~150; pure-left policy ~2
+            assert result["episode_return_mean"] > 30, result
+            # the IMPALA headline metric: async sampling keeps the learner fed
+            assert result["env_steps_per_s"] > 0
+            assert np.isfinite(result["mean_rho"]) and result["mean_rho"] > 0
+        finally:
+            trainer.stop()
