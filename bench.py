@@ -46,6 +46,84 @@ def detect_chip():
     return tpus[0], kind, 275e12
 
 
+def profile_ops(config, state, batch: int, seq: int, repeats: int = 5):
+    """Per-op timing decomposition of the train step (VERDICT r4 #5): where
+    do the milliseconds go? Each component is timed as its own jitted
+    program at the train step's exact shapes — an approximation (the real
+    step lets XLA fuse across these boundaries, so components can sum to
+    MORE than the whole), but it localizes the plateau: attention fwd+bwd
+    vs embedding/FFN matmuls vs the vocab-projection+CE tail vs optimizer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import llama_hidden, llama_loss
+    from ray_tpu.ops.attention import flash_attention, reference_attention
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq)),
+                         jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = state.params if hasattr(state, "params") else state["params"]
+
+    def timed(fn, *args):
+        fn = jax.jit(fn)
+        out = fn(*args)  # compile
+        jax.device_get(jax.tree.leaves(out)[0])
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = fn(*args)
+        jax.device_get(jax.tree.leaves(out)[0])
+        return (time.perf_counter() - t0) / repeats
+
+    # full fwd loss / fwd+bwd
+    fwd_s = timed(lambda p: llama_loss(p, tokens, targets, config), params)
+    fwdbwd_s = timed(
+        jax.grad(lambda p: llama_loss(p, tokens, targets, config)), params)
+
+    # attention alone at model shapes, all layers
+    h, d = config.num_heads, config.hidden_size // config.num_heads
+    hkv = config.num_kv_heads
+    q = jnp.asarray(rng.standard_normal((batch, seq, h, d)), config.dtype)
+    k = jnp.asarray(rng.standard_normal((batch, seq, hkv, d)), config.dtype)
+    v = jnp.asarray(rng.standard_normal((batch, seq, hkv, d)), config.dtype)
+    attn = (flash_attention if config.attention_impl in ("flash", "auto")
+            else reference_attention)
+    attn_fwd_s = timed(lambda q, k, v: attn(q, k, v, causal=True), q, k, v) \
+        * config.num_layers
+    attn_fb_s = timed(
+        jax.grad(lambda q, k, v: attn(q, k, v, causal=True)
+                 .astype(jnp.float32).sum(), argnums=(0, 1, 2)),
+        q, k, v) * config.num_layers
+
+    # vocab projection + CE tail (the model's fused seq-chunked path)
+    from ray_tpu.models.llama import _lm_head
+    from ray_tpu.ops.loss import fused_cross_entropy
+
+    hidden = jnp.asarray(
+        rng.standard_normal((batch, seq, config.hidden_size)), config.dtype)
+
+    def ce_tail(hid, p):
+        return fused_cross_entropy(hid, _lm_head(p, config), targets, None)
+
+    ce_s = timed(jax.grad(ce_tail, argnums=0), hidden, params)
+
+    # trunk without the CE tail (hidden states only), fwd
+    trunk_s = timed(lambda p: llama_hidden(p, tokens, config).sum(), params)
+
+    return {
+        "repeats": repeats,
+        "step_components_ms": {
+            "full_fwd": round(fwd_s * 1e3, 2),
+            "full_fwd_bwd": round(fwdbwd_s * 1e3, 2),
+            "attention_fwd_all_layers": round(attn_fwd_s * 1e3, 2),
+            "attention_fwd_bwd_all_layers": round(attn_fb_s * 1e3, 2),
+            "trunk_fwd_no_ce": round(trunk_s * 1e3, 2),
+            "ce_tail_fwd_bwd": round(ce_s * 1e3, 2),
+        },
+    }
+
+
 def main(large: bool = False) -> None:
     import jax
     import jax.numpy as jnp
@@ -125,6 +203,39 @@ def main(large: bool = False) -> None:
         "seq": seq,
         "loss": round(final_loss, 4),
     }
+
+    import os
+
+    # opt-in: the profile compiles ~8 extra XLA programs (several minutes on
+    # a cold cache) — too slow for the driver's default bench invocation
+    if on_tpu and os.environ.get("RAY_TPU_BENCH_PROFILE", "0") == "1":
+        try:
+            prof = profile_ops(config, state, batch, seq)
+            # optimizer alone (adamw over the full param tree)
+            import optax
+
+            grads = jax.tree.map(jnp.zeros_like, state.params)
+
+            @jax.jit
+            def opt_only(params, opt_state, grads):
+                updates, new_opt = opt.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), new_opt
+
+            p2, o2 = opt_only(state.params, state.opt_state, grads)
+            jax.device_get(jax.tree.leaves(p2)[0][:1])
+            t0 = time.perf_counter()
+            reps = prof["repeats"]
+            for _ in range(reps):
+                p2, o2 = opt_only(state.params, state.opt_state, grads)
+            jax.device_get(jax.tree.leaves(p2)[0][:1])
+            prof["step_components_ms"]["optimizer"] = round(
+                (time.perf_counter() - t0) / reps * 1e3, 2)
+            prof["step_components_ms"]["measured_full_step"] = round(
+                dt / steps * 1e3, 2)
+            result["per_op_profile"] = prof
+        except Exception as e:  # noqa: BLE001 - the headline must still print
+            result["per_op_profile"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     print(json.dumps(result))
 
 

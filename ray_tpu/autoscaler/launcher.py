@@ -1,0 +1,232 @@
+"""Cluster launcher: ``up / down / exec / attach`` against a cluster YAML.
+
+Reference capability: python/ray/autoscaler/_private/commands.py (`ray
+up/down/attach/exec` driving NodeProvider plugins). Redesign for this
+runtime: the head (GCS + head agent) starts as detached local processes;
+worker nodes come from the YAML's provider — "local" spawns agent
+subprocesses on this machine (the CI/test path, the FakeMultiNodeProvider
+analogue), "gce" drives the queued-resource TPU provider
+(autoscaler/gce.py). Cluster state (addresses + pids + provider handles)
+persists under ~/.ray_tpu/clusters/<name>.json so down/exec/attach work
+from any later shell.
+
+YAML shape:
+
+```yaml
+cluster_name: demo
+provider:
+  type: local            # or: gce (project/zone/accelerator fields)
+head:
+  num_cpus: 4
+workers:
+  count: 2
+  num_cpus: 2
+```
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+CLUSTERS_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+def _state_path(name: str) -> str:
+    return os.path.join(CLUSTERS_DIR, f"{name}.json")
+
+
+def load_state(name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_state_path(name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _save_state(name: str, state: Dict[str, Any]) -> None:
+    os.makedirs(CLUSTERS_DIR, exist_ok=True)
+    with open(_state_path(name), "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict) or not cfg.get("cluster_name"):
+        raise ValueError("cluster YAML needs a 'cluster_name'")
+    provider = cfg.get("provider") or {"type": "local"}
+    if provider.get("type") not in ("local", "gce"):
+        raise ValueError(f"unknown provider type {provider.get('type')!r}")
+    cfg["provider"] = provider
+    cfg.setdefault("head", {})
+    cfg.setdefault("workers", {"count": 0})
+    return cfg
+
+
+def _wait_ready(path: str, proc: subprocess.Popen, what: str,
+                timeout: float = 60.0) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            content = open(path).read().strip()
+            if content:
+                return content
+        if proc.poll() is not None:
+            raise RuntimeError(f"{what} exited with {proc.returncode}")
+        time.sleep(0.05)
+    raise TimeoutError(f"{what} not ready in {timeout}s")
+
+
+def _start_agent(gcs_address: str, session_dir: str, node_cfg: Dict[str, Any],
+                 head: bool = False) -> int:
+    ready = os.path.join(session_dir, f"agent-{uuid.uuid4().hex[:6]}.ready")
+    log = open(os.path.join(session_dir,
+                            f"agent-{'head' if head else uuid.uuid4().hex[:6]}.log"),
+               "ab")
+    cmd = [sys.executable, "-m", "ray_tpu.core.node.agent",
+           "--gcs", gcs_address, "--session-dir", session_dir,
+           "--ready-file", ready]
+    if node_cfg.get("num_cpus") is not None:
+        cmd += ["--num-cpus", str(node_cfg["num_cpus"])]
+    if node_cfg.get("num_tpus"):
+        cmd += ["--num-tpus", str(node_cfg["num_tpus"])]
+    for k, v in (node_cfg.get("resources") or {}).items():
+        cmd += ["--resource", f"{k}={v}"]
+    for k, v in (node_cfg.get("labels") or {}).items():
+        cmd += ["--label", f"{k}={v}"]
+    if head:
+        cmd += ["--head"]
+    env = dict(os.environ, RAY_TPU_SESSION_DIR=session_dir)
+    proc = subprocess.Popen(cmd, env=env, stdout=log,
+                            stderr=subprocess.STDOUT, start_new_session=True)
+    _wait_ready(ready, proc, "node agent")
+    return proc.pid
+
+
+def up(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Bring the cluster up; idempotent-ish (a live state file is an error —
+    run down first). Returns the saved state."""
+    name = config["cluster_name"]
+    if load_state(name):
+        raise RuntimeError(
+            f"cluster '{name}' already has state; run `down` first")
+    session_dir = f"/tmp/ray_tpu/cluster-{name}-{uuid.uuid4().hex[:6]}"
+    os.makedirs(session_dir, exist_ok=True)
+    pids: List[int] = []
+    worker_handles: List[str] = []
+    try:
+        # head: GCS + head agent as detached process groups
+        ready = os.path.join(session_dir, "gcs.ready")
+        gcs_log = open(os.path.join(session_dir, "gcs.log"), "ab")
+        gcs = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.gcs.server", "--ready-file", ready],
+            env=dict(os.environ, RAY_TPU_SESSION_DIR=session_dir),
+            stdout=gcs_log, stderr=subprocess.STDOUT, start_new_session=True)
+        pids.append(gcs.pid)
+        gcs_address = _wait_ready(ready, gcs, "GCS")
+        pids.append(_start_agent(gcs_address, session_dir,
+                                 config.get("head") or {}, head=True))
+        workers = config.get("workers") or {}
+        provider_cfg = config["provider"]
+        if provider_cfg["type"] == "local":
+            for _ in range(int(workers.get("count", 0))):
+                pid = _start_agent(gcs_address, session_dir, workers)
+                pids.append(pid)
+                worker_handles.append(f"pid:{pid}")
+        else:  # gce: queued-resource TPU workers join over the network
+            from ray_tpu.autoscaler.gce import GceTpuProvider
+
+            provider = GceTpuProvider(gcs_address=gcs_address, **{
+                k: v for k, v in provider_cfg.items() if k != "type"})
+            for _ in range(int(workers.get("count", 0))):
+                worker_handles.append(provider.create_node(dict(workers)))
+    except BaseException:
+        # a half-launched cluster with no state file would orphan detached
+        # process groups that `down` can never find — kill what we started
+        for pid in reversed(pids):
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        raise
+    state = {
+        "cluster_name": name,
+        "gcs_address": gcs_address,
+        "session_dir": session_dir,
+        "provider": provider_cfg,
+        "pids": pids,
+        "worker_handles": worker_handles,
+        "created_at": time.time(),
+    }
+    _save_state(name, state)
+    return state
+
+
+def down(name: str) -> None:
+    state = load_state(name)
+    if not state:
+        raise RuntimeError(f"no state for cluster '{name}'")
+    if state["provider"]["type"] == "gce" and state["worker_handles"]:
+        from ray_tpu.autoscaler.gce import GceTpuProvider
+
+        provider = GceTpuProvider(gcs_address=state["gcs_address"], **{
+            k: v for k, v in state["provider"].items() if k != "type"})
+        for handle in state["worker_handles"]:
+            try:
+                provider.terminate_node(handle)
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+    for pid in reversed(state.get("pids", [])):
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    try:
+        os.unlink(_state_path(name))
+    except OSError:
+        pass
+
+
+def exec_cmd(name: str, command: List[str],
+             capture: bool = False) -> subprocess.CompletedProcess:
+    """Run a command against the cluster (RAY_TPU_ADDRESS injected). With a
+    local provider this runs on this machine — which IS every node's
+    machine; remote-provider exec would ride SSH and is not wired here."""
+    state = load_state(name)
+    if not state:
+        raise RuntimeError(f"no state for cluster '{name}'")
+    env = dict(os.environ, RAY_TPU_ADDRESS=state["gcs_address"],
+               RAY_TPU_SESSION_DIR=state["session_dir"])
+    return subprocess.run(command, env=env, capture_output=capture, text=True)
+
+
+def attach(name: str) -> int:
+    """Interactive shell with the cluster's environment exported."""
+    state = load_state(name)
+    if not state:
+        raise RuntimeError(f"no state for cluster '{name}'")
+    shell = os.environ.get("SHELL", "/bin/sh")
+    print(f"attached to '{name}' (RAY_TPU_ADDRESS={state['gcs_address']}); "
+          "exit the shell to detach")
+    return subprocess.call(
+        [shell], env=dict(os.environ, RAY_TPU_ADDRESS=state["gcs_address"]))
+
+
+def list_clusters() -> List[Dict[str, Any]]:
+    out = []
+    if os.path.isdir(CLUSTERS_DIR):
+        for fname in sorted(os.listdir(CLUSTERS_DIR)):
+            if fname.endswith(".json"):
+                st = load_state(fname[:-5])
+                if st:
+                    out.append(st)
+    return out

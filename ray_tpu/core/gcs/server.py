@@ -888,6 +888,13 @@ class GcsServer:
             rec["locations"].discard(node_id)
         return True
 
+    async def rpc_dump_stacks(self) -> str:
+        """All thread stacks of THIS process (`ray_tpu stack` backend;
+        reference capability: `ray stack` py-spy dump)."""
+        from ray_tpu.utils.debug import format_all_stacks
+
+        return format_all_stacks()
+
     async def rpc_list_objects(self, limit: int = 1000) -> List[Dict[str, Any]]:
         out = []
         for object_id, rec in self.objects.items():

@@ -2282,6 +2282,29 @@ class NodeAgent:
         except OSError:
             return []
 
+    async def rpc_dump_stacks(self) -> str:
+        """All thread stacks of THIS process (`ray_tpu stack` backend;
+        reference capability: `ray stack` py-spy dump)."""
+        from ray_tpu.utils.debug import format_all_stacks
+
+        return format_all_stacks()
+
+    async def rpc_dump_worker_stacks(self) -> Dict[str, str]:
+        """Relay dump_stacks to every live worker on this node — where hung
+        USER code actually lives (the `ray stack` use-case)."""
+        out: Dict[str, str] = {}
+
+        async def one(worker_id: str, w) -> None:
+            if w.client is None or w.proc.poll() is not None:
+                return
+            try:
+                out[worker_id] = await w.client.call("dump_stacks", timeout=10.0)
+            except Exception as e:  # noqa: BLE001 - a stuck worker still times out
+                out[worker_id] = f"<dump failed: {type(e).__name__}: {e}>"
+
+        await asyncio.gather(*[one(wid, w) for wid, w in self._workers.items()])
+        return out
+
     async def rpc_node_info(self) -> Dict[str, Any]:
         import socket
 

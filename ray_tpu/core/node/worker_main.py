@@ -85,6 +85,21 @@ class WorkerProcess:
             "worker_ready", worker_id=self.worker_id, address=self.rpc.address,
             client_holder=runtime.client_id,
         )
+        # tracing bridge: trace spans (created only for specs that carry a
+        # __trace_ctx__ from a tracing-enabled driver) fold into the
+        # profiling pipeline and land on the cluster timeline
+        from ray_tpu import profiling
+        from ray_tpu.util import tracing
+
+        def _bridge(spans) -> None:
+            for s in spans:
+                profiling.record_external_span(
+                    s["name"], s["start_s"], s.get("end_s", s["start_s"]),
+                    extra={"trace_id": s["trace_id"], "span_id": s["span_id"],
+                           "parent_id": s.get("parent_id")},
+                )
+
+        tracing.set_exporter(_bridge)  # record ONLY driver-traced tasks
         spawn(self._agent_watchdog())
         logger.info("worker %s ready at %s", self.worker_id[:8], self.rpc.address)
 
@@ -345,7 +360,9 @@ class WorkerProcess:
         """Ship this thread's recorded profile spans to the agent (one RPC,
         only when ray_tpu.profile() was used in the task)."""
         from ray_tpu import profiling
+        from ray_tpu.util import tracing
 
+        tracing.flush()  # bridge exporter folds trace spans into profiling
         spans = profiling.drain()
         if not spans:
             return
@@ -367,12 +384,15 @@ class WorkerProcess:
         task_id = TaskID(bytes.fromhex(spec["task_id"]))
         attempts = 0
         max_attempts = 1 + (spec.get("max_retries", 0) if spec.get("retry_exceptions") else 0)
+        from ray_tpu.util import tracing
+
         while True:
             w.set_task_context(task_id, None, spec.get("name", ""), attempt=attempts)
             try:
-                fn = self._load_function(spec["function_id"])
-                args, kwargs = self._resolve_args(spec["args_payload"])
-                result = fn(*args, **kwargs)
+                with tracing.task_execution_span(spec):
+                    fn = self._load_function(spec["function_id"])
+                    args, kwargs = self._resolve_args(spec["args_payload"])
+                    result = fn(*args, **kwargs)
                 if spec.get("streaming"):
                     return self._drive_streaming(spec, result)
                 inline: List[Dict[str, Any]] = []
@@ -504,6 +524,13 @@ class WorkerProcess:
     async def rpc_terminate(self) -> bool:
         asyncio.get_event_loop().call_later(0.05, os._exit, 0)
         return True
+
+    async def rpc_dump_stacks(self) -> str:
+        """All thread stacks of THIS process (`ray_tpu stack` backend;
+        reference capability: `ray stack` py-spy dump)."""
+        from ray_tpu.utils.debug import format_all_stacks
+
+        return format_all_stacks()
 
     async def rpc_ping(self) -> str:
         return "pong"

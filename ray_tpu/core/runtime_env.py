@@ -25,7 +25,6 @@ import zipfile
 from typing import Any, Dict, Optional, Tuple
 
 MAX_PACKAGE_BYTES = 64 * 1024 * 1024
-_INTERNAL_KEYS = ("__actor_name__", "__actor_namespace__")
 SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules"}
 REJECTED_KEYS = {"pip", "conda", "container", "py_executable"}
 
@@ -33,7 +32,11 @@ REJECTED_KEYS = {"pip", "conda", "container", "py_executable"}
 def normalize(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """Strip internal keys, validate, canonicalize. Raises on unsupported
     install-at-runtime requests."""
-    env = {k: v for k, v in (runtime_env or {}).items() if k not in _INTERNAL_KEYS}
+    # every "__"-prefixed key is framework-internal plumbing (actor names,
+    # trace context, ...): stripped here, re-merged verbatim by
+    # cluster_runtime._prepare_runtime_env
+    env = {k: v for k, v in (runtime_env or {}).items()
+           if not k.startswith("__")}
     if not env:
         return {}
     bad = set(env) & REJECTED_KEYS

@@ -62,6 +62,18 @@ def profile(name: str, extra: Optional[Dict[str, Any]] = None):
             del _spans[:-_MAX_PENDING]
 
 
+def record_external_span(name: str, start: float, end: float,
+                         extra: Optional[Dict[str, Any]] = None) -> None:
+    """Record an already-timed span (the tracing bridge: util/tracing.py
+    spans ride the same flush path to the agent/timeline)."""
+    span: Dict[str, Any] = {"name": str(name), "start": start, "end": end}
+    if extra:
+        span["extra"] = {str(k): v for k, v in extra.items()}
+    with _lock:
+        _spans.append(span)
+        del _spans[:-_MAX_PENDING]
+
+
 def drain() -> List[Dict[str, Any]]:
     """Take (and clear) every recorded span (worker/local flush paths)."""
     global _spans

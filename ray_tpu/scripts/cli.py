@@ -314,9 +314,114 @@ def cmd_serve(args) -> None:
         return
 
 
+def cmd_stack(args) -> None:
+    """All thread stacks of every cluster component (reference: `ray stack`
+    py-spy dumps; here interpreter-level via dump_stacks RPCs)."""
+    from ray_tpu.core.rpc import SyncRpcClient
+
+    addr = _resolve_address(args)
+    gcs = SyncRpcClient(addr)
+    try:
+        print(f"=== GCS {addr} ===")
+        print(gcs.call("dump_stacks", timeout=15.0))
+        for n in gcs.call("get_nodes"):
+            if not n.get("Alive"):
+                continue
+            agent_addr = n["NodeManagerAddress"]
+            print(f"=== node agent {n['NodeID'][:8]} @ {agent_addr} ===")
+            agent = SyncRpcClient(agent_addr)
+            try:
+                print(agent.call("dump_stacks", timeout=15.0))
+                for worker_id, dump in (agent.call(
+                        "dump_worker_stacks", timeout=30.0) or {}).items():
+                    print(f"=== worker {worker_id[:12]} "
+                          f"(node {n['NodeID'][:8]}) ===")
+                    print(dump)
+            finally:
+                agent.close()
+    finally:
+        gcs.close()
+
+
+def cmd_memory(args) -> None:
+    """Object-table dump with sizes/locations/holders (reference:
+    `ray memory` ref-count debugging)."""
+    from ray_tpu.core.rpc import SyncRpcClient
+
+    gcs = SyncRpcClient(_resolve_address(args))
+    try:
+        objs = gcs.call("list_objects", limit=args.limit)
+    finally:
+        gcs.close()
+    total = sum(o["size"] or 0 for o in objs)
+    print(f"{'OBJECT':48}  {'SIZE':>12}  {'LOCS':>4}  {'HOLDERS':>7}  LINEAGE")
+    for o in sorted(objs, key=lambda x: -(x["size"] or 0)):
+        print(f"{o['object_id'][:48]:48}  {o['size'] or 0:>12}  "
+              f"{len(o['locations']):>4}  {o['holders']:>7}  "
+              f"{'yes' if o['has_lineage'] else ''}")
+    print(f"-- {len(objs)} objects, {total / 1e6:.1f} MB total")
+
+
+def cmd_up(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    state = launcher.up(launcher.load_config(args.config_file))
+    print(json.dumps({k: state[k] for k in
+                      ("cluster_name", "gcs_address", "session_dir")}, indent=2))
+    print(f"exec with: ray_tpu exec {state['cluster_name']} -- CMD")
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    launcher.down(args.cluster_name)
+    print(f"cluster '{args.cluster_name}' torn down")
+
+
+def cmd_exec(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    if not args.cmd:
+        sys.exit("usage: ray_tpu exec NAME -- CMD [ARGS...]")
+    proc = launcher.exec_cmd(args.cluster_name, args.cmd)
+    sys.exit(proc.returncode)
+
+
+def cmd_attach(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    sys.exit(launcher.attach(args.cluster_name))
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p.add_argument("config_file")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down a launched cluster")
+    p.add_argument("cluster_name")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("exec", help="run a command against a launched cluster")
+    p.add_argument("cluster_name")
+    p.add_argument("cmd", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_exec)
+
+    p = sub.add_parser("attach", help="shell with the cluster env exported")
+    p.add_argument("cluster_name")
+    p.set_defaults(fn=cmd_attach)
+
+    p = sub.add_parser("stack", help="dump all thread stacks of every component")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("memory", help="object table: sizes/locations/holders")
+    p.add_argument("--address", default=None)
+    p.add_argument("--limit", type=int, default=1000)
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("start", help="start a head or worker node")
     p.add_argument("--head", action="store_true")

@@ -1,0 +1,24 @@
+"""Process self-inspection helpers behind the ``stack``/``memory`` debug
+CLIs (reference: `ray stack` py-spy dumps + `ray memory` ref-count tables,
+python/ray/scripts/scripts.py:2616). py-spy isn't in the image, so stacks
+come from the interpreter itself (sys._current_frames) via a dump_stacks
+RPC on every component."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+
+
+def format_all_stacks() -> str:
+    frames = sys._current_frames()
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        t = by_ident.get(ident)
+        name = t.name if t else f"thread-{ident}"
+        daemon = t.daemon if t else "?"
+        out.append(f"--- {name} (daemon={daemon}) ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)

@@ -57,6 +57,83 @@ def _atomic_write(path: str, data: bytes) -> None:
     os.replace(tmp, path)  # atomic commit: a crash never leaves a torn file
 
 
+# --------------------------------------------------------------------------- #
+# Events (reference: python/ray/workflow/event_listener.py — workflows that
+# block on external signals, durably: once the event step's checkpoint is
+# committed, resume() never waits again) and dynamic continuations
+# (reference: workflow.continuation — a step may RETURN a new sub-DAG which
+# runs in its place, checkpointed under the same step).
+# --------------------------------------------------------------------------- #
+class EventListener:
+    """Subclass and implement poll_for_event (blocking; return the event
+    payload). Instantiated INSIDE the event step's task."""
+
+    def poll_for_event(self, *args, **kwargs) -> Any:
+        raise NotImplementedError
+
+
+class KVEventListener(EventListener):
+    """Built-in listener: waits for a cluster-KV key to appear (external
+    systems signal by ray_tpu.kv_put). Returns the key's bytes."""
+
+    def poll_for_event(self, key: str, poll_interval_s: float = 0.2,
+                       timeout_s: Optional[float] = None):
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            value = ray_tpu.kv_get(key)
+            if value is not None:
+                return value
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"no event on KV key {key!r} in {timeout_s}s")
+            time.sleep(poll_interval_s)
+
+
+class TimerListener(EventListener):
+    """Fires at an absolute unix timestamp (durable sleep)."""
+
+    def poll_for_event(self, fire_at: float):
+        while True:
+            now = time.time()
+            if now >= fire_at:
+                return fire_at
+            time.sleep(min(1.0, fire_at - now))
+
+
+def _poll_event_task(payload: bytes):
+    listener_cls, args, kwargs = cloudpickle.loads(payload)
+    return listener_cls().poll_for_event(*args, **kwargs)
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> DAGNode:
+    """A DAG node that blocks until the listener fires. As a FunctionNode it
+    checkpoints like any step: the event is consumed EXACTLY ONCE across
+    crash/resume (reference: event_listener.py + checkpointed event step)."""
+    if not (isinstance(listener_cls, type) and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event needs an EventListener subclass")
+    fn = ray_tpu.remote(_poll_event_task)
+    fn._name = f"event_{listener_cls.__name__}"
+    return fn.bind(cloudpickle.dumps((listener_cls, args, kwargs)))
+
+
+class Continuation:
+    __slots__ = ("dag",)
+
+    def __init__(self, dag: DAGNode):
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    """Return this from a workflow step to continue INTO a dynamically-built
+    sub-DAG: the sub-DAG runs in the step's place and its result becomes the
+    step's checkpointed value. Sub-steps checkpoint individually, so a crash
+    mid-continuation replays only the incomplete tail. Requirement (same as
+    the reference): the parent step must rebuild the same sub-DAG shape on
+    re-execution."""
+    if not isinstance(dag, DAGNode):
+        raise TypeError("continuation() takes a DAG node")
+    return Continuation(dag)
+
+
 def _step_id(node: DAGNode, order: Dict[int, int]) -> str:
     name = type(node).__name__
     fn = getattr(node, "_fn", None)
@@ -139,6 +216,17 @@ class WorkflowExecution:
                                 for k, v in n._kwargs.items()}
                     ref = n._fn.remote(*r_args, **r_kwargs)
                     value = ray_tpu.get(ref)
+                    # dynamic continuation: the step returned a sub-DAG to
+                    # run in its place; its nodes get fresh deterministic
+                    # ids and checkpoint individually, and the FINAL value
+                    # lands under THIS step's checkpoint
+                    while isinstance(value, Continuation):
+                        base = (max(self._order.values()) + 1
+                                if self._order else 0)
+                        for j, sub in enumerate(value.dag.walk()):
+                            if id(sub) not in self._order:
+                                self._order[id(sub)] = base + j
+                        value = resolve(value.dag)
                     # checkpoint BEFORE the value is consumed downstream:
                     # a crash after this line never re-runs the step
                     _atomic_write(self._ckpt_path(sid),
