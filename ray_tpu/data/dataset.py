@@ -473,6 +473,38 @@ class DataIterator:
         for ref in self._refs():
             yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
 
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         prefetch_batches: int = 2, drop_last: bool = True,
+                         sharding=None, dtype=None) -> Iterator[Dict[str, Any]]:
+        """Device-side prefetch on a streaming_split shard — the per-train-
+        worker half of the data->train path (reference: DataIterator.
+        iter_torch_batches used by Train via DataConfig)."""
+        import jax
+
+        from ray_tpu.core.config import config
+
+        host_iter = self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            prefetch_batches=prefetch_batches, drop_last=drop_last,
+        )
+
+        def to_device(batch):
+            out = {}
+            for k, v in batch.items():
+                arr = v if dtype is None else v.astype(dtype)
+                out[k] = (jax.device_put(arr, sharding)
+                          if sharding is not None else jax.device_put(arr))
+            return out
+
+        depth = max(1, config.device_prefetch_depth)
+        buf: "_queue.deque" = __import__("collections").deque()
+        for batch in host_iter:
+            buf.append(to_device(batch))
+            if len(buf) >= depth:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
 
 def _batch_iterator(refs: Iterator[ObjectRef], batch_size: int, batch_format: str,
                     prefetch_batches: int, drop_last: bool) -> Iterator[Batch]:
