@@ -31,11 +31,94 @@ PEAK_FLOPS = [
 ]
 MFU_TARGET = 0.40
 
+# backend-init hardening (VERDICT r5 weak #1: one transient environment
+# outage must never zero a bench round again)
+BACKEND_INIT_RETRIES = 3
+BACKEND_INIT_BACKOFF_S = 5.0
+BACKEND_INIT_TIMEOUT_S = 180.0
 
-def detect_chip():
-    import jax
 
-    devs = jax.devices()
+def collect_diagnostics() -> dict:
+    """Environment snapshot for the error JSON: which env vars steer the
+    backend, whether the TPU device files exist, and which processes hold
+    them (the classic outage: a zombie holds /dev/accel* or the libtpu
+    lockfile and every init after it hangs)."""
+    import glob
+    import os
+
+    diag = {
+        "env": {k: v for k, v in os.environ.items()
+                if k.startswith(("JAX_", "TPU_", "XLA_", "PALLAS_", "LIBTPU"))},
+        "device_files": sorted(glob.glob("/dev/accel*")
+                               + glob.glob("/dev/vfio/*")),
+        "libtpu_lockfile": os.path.exists("/tmp/libtpu_lockfile"),
+    }
+    holders = []
+    try:
+        for pid_dir in glob.glob("/proc/[0-9]*"):
+            try:
+                for fd in os.listdir(os.path.join(pid_dir, "fd")):
+                    target = os.readlink(os.path.join(pid_dir, "fd", fd))
+                    if target.startswith(("/dev/accel", "/dev/vfio")):
+                        cmdline = open(os.path.join(pid_dir, "cmdline"), "rb") \
+                            .read().replace(b"\0", b" ").decode()[:160]
+                        holders.append({"pid": int(os.path.basename(pid_dir)),
+                                        "device": target, "cmd": cmdline})
+                        break
+            except OSError:
+                continue  # process vanished / not ours
+    except OSError:
+        pass
+    diag["device_holders"] = holders[:16]
+    return diag
+
+
+def _init_backend_with_timeout(timeout_s: float):
+    """jax.devices() with a hard deadline: libtpu init can wedge forever on
+    a held chip, and a wedged bench is worse than a failed one."""
+    import concurrent.futures
+
+    def probe():
+        import jax
+
+        return jax.devices()
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+        fut = pool.submit(probe)
+        try:
+            return fut.result(timeout=timeout_s)
+        except concurrent.futures.TimeoutError:
+            # the hung thread is unkillable; surface the deadline loudly and
+            # let the process exit tear it down
+            raise TimeoutError(
+                f"backend initialization exceeded {timeout_s:.0f}s"
+            ) from None
+
+
+def detect_chip(retries: int = BACKEND_INIT_RETRIES,
+                backoff_s: float = BACKEND_INIT_BACKOFF_S):
+    """Chip detection with bounded retry + backoff: transient libtpu/driver
+    hiccups (device briefly held by a dying process, flaky tunnel) resolve
+    within seconds — retrying beats zeroing the round."""
+    import time as _time
+
+    last_err = None
+    for attempt in range(max(1, retries)):
+        try:
+            devs = _init_backend_with_timeout(BACKEND_INIT_TIMEOUT_S)
+            break
+        except Exception as e:  # noqa: BLE001 - retried, then re-raised
+            last_err = e
+            if attempt + 1 >= max(1, retries):
+                raise RuntimeError(
+                    f"backend init failed after {retries} attempts: "
+                    f"{type(e).__name__}: {e}"
+                ) from e
+            sleep_s = backoff_s * (2 ** attempt)
+            print(f"bench: backend init attempt {attempt + 1} failed "
+                  f"({type(e).__name__}: {e}); retrying in {sleep_s:.0f}s",
+                  file=sys.stderr)
+            _time.sleep(sleep_s)
     tpus = [d for d in devs if d.platform == "tpu"]
     if not tpus:
         return None, "cpu", 1e12
@@ -248,6 +331,10 @@ if __name__ == "__main__":
         # instead of the tuned flagship (see BENCH_LARGE_r04.json analysis)
         main(large=_large)
     except Exception as e:  # noqa: BLE001 - the driver needs a JSON line no matter what
+        try:
+            diagnostics = collect_diagnostics()
+        except Exception as diag_err:  # noqa: BLE001
+            diagnostics = {"error": f"{type(diag_err).__name__}: {diag_err}"[:200]}
         print(json.dumps({
             "metric": ("llama_train_largest_fit_tokens_per_sec_per_chip"
                        if _large else "llama_train_tokens_per_sec_per_chip"),
@@ -255,5 +342,6 @@ if __name__ == "__main__":
             "unit": "tokens/s",
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}"[:400],
+            "diagnostics": diagnostics,
         }))
         sys.exit(0)

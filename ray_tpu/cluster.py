@@ -47,6 +47,15 @@ class Cluster:
 
     def __init__(self, initialize_head: bool = True, head_node_args: Optional[Dict] = None,
                  gcs_persist: bool = False):
+        # reclaim shm arenas orphaned by a SIGKILLed previous cluster (their
+        # agents never ran cleanup()); scoped to dead owners only, so live
+        # concurrent clusters on this box are untouched
+        try:
+            from ray_tpu.core.shm_store import sweep_dead_arenas
+
+            sweep_dead_arenas()
+        except Exception:  # noqa: BLE001 - janitor must not block startup
+            pass
         self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_cluster_")
         self._gcs_proc: Optional[subprocess.Popen] = None
         self.gcs_address: Optional[str] = None

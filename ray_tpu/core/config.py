@@ -220,8 +220,17 @@ _DEFINITIONS = [
     ("device_prefetch_depth", 2, int, "Host->HBM double-buffering depth for data loading."),
     # --- data ---
     ("data_memory_fraction", 0.25, float,
-     "Fraction of the object-store budget one Data stage may hold in flight "
-     "(byte-budget backpressure; reference: execution/resource_manager.py)."),
+     "Fraction of the object-store budget the streaming Data executor may "
+     "hold in flight across all operators (the ResourceManager's global "
+     "memory budget; reference: execution/resource_manager.py)."),
+    ("data_default_op_concurrency", 4, int,
+     "Default in-flight task cap per physical Data operator "
+     "(ConcurrencyCapBackpressurePolicy; override per-op via "
+     "map_batches(concurrency=...))."),
+    ("data_max_queued_blocks", 4, int,
+     "Max un-consumed output blocks per physical Data operator (its output "
+     "queue + the downstream input queue) before the downstream-capacity "
+     "backpressure policy stops its dispatches."),
 ]
 
 

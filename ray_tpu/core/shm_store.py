@@ -51,6 +51,85 @@ def arena_path(node_suffix: str) -> str:
     return os.path.join(_SHM_DIR, f"rtpu-arena-{node_suffix[:8]}")
 
 
+def _arena_pid_path(path: str) -> str:
+    return path + ".pid"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # another user's live process
+    except OSError:
+        return True  # unknowable: never sweep what might be alive
+    return True
+
+
+def write_arena_pidfile(path: str, pid: Optional[int] = None) -> None:
+    """Record the owning process of an arena file. Written BEFORE the arena
+    is created so a concurrent sweeper always sees a live owner."""
+    try:
+        with open(_arena_pid_path(path), "w") as f:
+            f.write(str(pid if pid is not None else os.getpid()))
+    except OSError:
+        pass  # /dev/shm unwritable: the arena create will fail loudly anyway
+
+
+def arena_owner_alive(path: str) -> bool:
+    """True unless the pidfile names a provably-dead process. A missing or
+    corrupt pidfile counts as DEAD: every arena creator in this codebase
+    writes the pidfile first, so an arena without one is a pre-pidfile
+    orphan (or lost its owner before finishing startup)."""
+    try:
+        pid = int(open(_arena_pid_path(path)).read().strip())
+    except (OSError, ValueError):
+        return False
+    return _pid_alive(pid)
+
+
+def sweep_dead_arenas() -> List[str]:
+    """Reclaim arenas whose owner process is gone (reference capability:
+    raylet startup cleanup of stale plasma sockets/segments). A SIGKILLed
+    agent cannot run ShmObjectStore.cleanup(), so its multi-GB arena file
+    would pin /dev/shm forever; every agent/cluster startup calls this to
+    reclaim them. Returns the arena paths removed."""
+    import glob as _glob
+
+    removed: List[str] = []
+    for path in _glob.glob(os.path.join(_SHM_DIR, "rtpu-arena-*")):
+        if path.endswith(".pid"):
+            continue
+        if arena_owner_alive(path):
+            continue
+        for p in (path, _arena_pid_path(path)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        removed.append(path)
+        logger.info("swept orphaned shm arena %s", path)
+    # pidfiles whose arena vanished (crash between unlinks): drop them too
+    for pidfile in _glob.glob(os.path.join(_SHM_DIR, "rtpu-arena-*.pid")):
+        if not os.path.exists(pidfile[: -len(".pid")]):
+            try:
+                os.unlink(pidfile)
+            except OSError:
+                pass
+    return removed
+
+
+def find_orphan_arenas() -> List[str]:
+    """Arenas (not pidfiles) whose owner is dead — the post-suite CI check."""
+    import glob as _glob
+
+    return [
+        path for path in _glob.glob(os.path.join(_SHM_DIR, "rtpu-arena-*"))
+        if not path.endswith(".pid") and not arena_owner_alive(path)
+    ]
+
+
 # process-wide cache of attached arenas (one mmap per process per node)
 _arena_cache: Dict[str, Any] = {}
 _arena_lock = threading.Lock()
@@ -252,10 +331,20 @@ class ShmObjectStore:
         backend = backend or config.object_store_backend
         self._arena = None
         if backend in ("auto", "arena"):
+            # agent startup doubles as the node's arena janitor: reclaim any
+            # arena whose owner died without running cleanup() (SIGKILLed
+            # cluster) before creating our own
+            try:
+                sweep_dead_arenas()
+            except OSError:
+                pass
             try:
                 from ray_tpu import _native
 
                 if _native.available():
+                    # pidfile BEFORE the arena: a concurrent sweeper must
+                    # always observe a live owner for a nascent arena
+                    write_arena_pidfile(arena_path(node_suffix))
                     self._arena = _native.Arena(
                         arena_path(node_suffix), capacity=self.capacity,
                         create=True,
@@ -596,6 +685,10 @@ class ShmObjectStore:
             arena.close()
             try:
                 arena.unlink()
+            except OSError:
+                pass
+            try:
+                os.unlink(_arena_pid_path(arena_path(self.node_suffix)))
             except OSError:
                 pass
             return

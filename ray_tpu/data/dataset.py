@@ -1,15 +1,17 @@
 """Dataset: lazy, streaming-executed distributed data.
 
 Reference capability: python/ray/data/dataset.py (+ read_api.py,
-iterator.py): lazy logical plan built by transformations, executed by the
-streaming executor on iteration/consumption; per-worker shards via
-streaming_split; device-prefetching batch iteration for TPU input pipelines
-(the host→HBM double-buffering tier the reference leaves to torch loaders).
+iterator.py): lazy logical plan built by transformations, compiled by
+``ray_tpu.data.execution.planner`` into a physical operator DAG and run by
+the pull-based ``execution.StreamingExecutor`` (per-op budgets,
+backpressure, per-op stats — see data/execution/DESIGN.md) on
+iteration/consumption; per-worker shards via streaming_split;
+device-prefetching batch iteration for TPU input pipelines (the host→HBM
+double-buffering tier the reference leaves to torch loaders).
 """
 
 from __future__ import annotations
 
-import itertools
 import queue as _queue
 import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
@@ -19,15 +21,16 @@ import numpy as np
 import ray_tpu
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.data.block import Batch, Block, BlockAccessor, block_from_batch, block_from_rows, concat_blocks
+from ray_tpu.data.execution.planner import build_physical_plan
+from ray_tpu.data.execution.streaming_executor import StreamingExecutor
 from ray_tpu.data.executor import (
-    DEFAULT_MAX_IN_FLIGHT,
     AggregateStage,
+    LimitStage,
     MapStage,
     RepartitionStage,
     ShuffleStage,
     SortStage,
     Stage,
-    StreamingExecutor,
     ZipStage,
 )
 from ray_tpu.utils.logging import get_logger
@@ -36,7 +39,9 @@ logger = get_logger("data")
 
 
 class Dataset:
-    def __init__(self, source_fn: Callable[[], Iterator[ObjectRef]], stages: Optional[List[Stage]] = None):
+    def __init__(self, source_fn: Any, stages: Optional[List[Stage]] = None):
+        # source_fn: callable returning an Iterator[ObjectRef], or a
+        # ReadTaskSource (read_api) whose read tasks the executor paces
         self._source_fn = source_fn
         self._stages: List[Stage] = stages or []
 
@@ -75,7 +80,8 @@ class Dataset:
             return block_from_batch(fn(batch))
 
         return self._with_stage(
-            MapStage(f"map_batches({getattr(fn, '__name__', 'fn')})", block_fn, num_cpus=num_cpus)
+            MapStage(f"map_batches({getattr(fn, '__name__', 'fn')})", block_fn,
+                     num_cpus=num_cpus, concurrency=concurrency)
         )
 
     def map(self, fn: Callable[[Dict], Dict], num_cpus: float = 1.0) -> "Dataset":
@@ -171,29 +177,24 @@ class Dataset:
         return Dataset(source)
 
     def limit(self, n: int) -> "Dataset":
-        parent = self
-
-        def source() -> Iterator[ObjectRef]:
-            remaining = n
-            for ref in parent._execute():
-                if remaining <= 0:
-                    return
-                block = ray_tpu.get(ref)
-                rows = block.num_rows
-                if rows <= remaining:
-                    remaining -= rows
-                    yield ref
-                else:
-                    yield ray_tpu.put(BlockAccessor(block).slice(0, remaining))
-                    remaining = 0
-
-        return Dataset(source)
+        """First n rows; compiles to a LimitOp that short-circuits upstream
+        operators (reads stop submitting once the limit is satisfied)."""
+        return self._with_stage(LimitStage(n))
 
     # ----------------------------------------------------------- consumption
-    def _execute(self, collect_rows: bool = False) -> Iterator[ObjectRef]:
-        executor = StreamingExecutor(self._stages, collect_rows=collect_rows)
+    def _build_executor(self, collect_rows: bool = False,
+                        output_split: Optional[int] = None,
+                        equal_split: bool = True) -> StreamingExecutor:
+        ops = build_physical_plan(self._source_fn, self._stages,
+                                  output_split=output_split,
+                                  equal_split=equal_split)
+        executor = StreamingExecutor(ops, collect_rows=collect_rows)
         self._last_executor = executor
-        return executor.execute(self._source_fn())
+        return executor
+
+    def _execute(self, collect_rows: bool = False) -> Iterator[ObjectRef]:
+        executor = self._build_executor(collect_rows=collect_rows)
+        return (bundle.ref for bundle in executor.execute())
 
     def iter_internal_refs(self) -> Iterator[ObjectRef]:
         return self._execute()
@@ -288,10 +289,14 @@ class Dataset:
 
         def feeder() -> None:
             try:
-                for i, ref in enumerate(parent._execute()):
+                # terminal OutputSplitOp tags each bundle with its consumer
+                executor = parent._build_executor(output_split=n,
+                                                  equal_split=equal)
+                for bundle in executor.execute():
                     # put the BLOCK (values serialize; refs are per-process
                     # futures only in local mode)
-                    ray_tpu.get(shards[i % n].put.remote(ray_tpu.get(ref)))
+                    idx = bundle.output_split_idx or 0
+                    ray_tpu.get(shards[idx].put.remote(ray_tpu.get(bundle.ref)))
             finally:
                 for s in shards:
                     s.close.remote()
@@ -329,17 +334,24 @@ class Dataset:
             pacsv.write_csv(ray_tpu.get(ref), f"{path}/part-{i:05d}.csv")
 
     def stats(self) -> str:
-        """Per-stage wall-time/blocks/rows of the LAST execution (runs the
-        pipeline with row collection if nothing has executed yet).
-        Reference: Dataset.stats() backed by _internal/stats.py."""
+        """Per-operator blocks/bytes/time/queue metrics of the LAST
+        execution (runs the pipeline with row collection if nothing has
+        executed yet). Reference: Dataset.stats() backed by
+        _internal/stats.py."""
         last = getattr(self, "_last_executor", None)
-        # blocks_out == 0 everywhere means an execution was CREATED but never
-        # consumed (stats are appended eagerly per stage) — run for real
-        if last is None or not any(st.blocks_out for st in last.stats):
+        # no output anywhere means an execution was CREATED but never
+        # consumed — run for real, collecting row counts
+        if last is None or not last.any_output_produced():
             for _ in self._execute(collect_rows=True):
                 pass
             last = self._last_executor
         return last.summary()
+
+    def stats_rows(self) -> List[Dict[str, Any]]:
+        """Structured per-operator stats of the last execution (the rows
+        behind ``stats()``; empty if nothing has executed)."""
+        last = getattr(self, "_last_executor", None)
+        return last.stats_rows() if last is not None else []
 
     def __repr__(self) -> str:
         return f"Dataset(num_stages={len(self._stages)})"

@@ -1,0 +1,50 @@
+"""Logical plan -> physical operator compilation (reference:
+python/ray/data/_internal/planner/planner.py: logical operators map 1:1
+onto physical operators; all-to-all stages keep their distributed exchange
+implementations as the bulk transform behind an ``AllToAllOp`` barrier)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ray_tpu.data.execution.interfaces import PhysicalOperator
+from ray_tpu.data.execution.operators import (
+    ActorPoolMapOp,
+    AllToAllOp,
+    InputDataOp,
+    LimitOp,
+    OutputSplitOp,
+    TaskPoolMapOp,
+)
+
+
+def build_physical_plan(source: Any, stages: List[Any],
+                        output_split: Optional[int] = None,
+                        equal_split: bool = True) -> List[PhysicalOperator]:
+    """``source`` is a ReadTaskSource or a callable returning a ref
+    iterator (Dataset._source_fn); ``stages`` are the logical stages from
+    ``ray_tpu.data.executor``."""
+    from ray_tpu.data.executor import LimitStage, MapStage
+
+    ops: List[PhysicalOperator] = [InputDataOp(source)]
+    for stage in stages:
+        if isinstance(stage, MapStage):
+            if stage.fn_constructor is not None:
+                ops.append(ActorPoolMapOp(
+                    stage.name, stage.block_fn, stage.fn_constructor,
+                    concurrency=stage.concurrency, num_cpus=stage.num_cpus,
+                ))
+            else:
+                ops.append(TaskPoolMapOp(
+                    stage.name, stage.block_fn, num_cpus=stage.num_cpus,
+                    concurrency=stage.concurrency,
+                ))
+        elif isinstance(stage, LimitStage):
+            ops.append(LimitOp(stage.limit))
+        else:
+            # all-to-all family (repartition/shuffle/sort/aggregate/zip):
+            # the stage's execute() IS the bulk exchange
+            ops.append(AllToAllOp(stage.name, stage.execute))
+    if output_split is not None:
+        ops.append(OutputSplitOp(output_split, equal=equal_split))
+    return ops

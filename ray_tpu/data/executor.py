@@ -1,24 +1,22 @@
-"""Streaming executor: pull-based pipelined execution of the operator DAG.
+"""Logical stages of the Data plan + the distributed all-to-all exchanges.
 
-Reference capability: python/ray/data/_internal/execution/streaming_executor.py
-(:48, scheduling loop :272 — select_operator_to_run under resource budgets,
-process_completed_tasks, backpressure via concurrency caps). Redesign:
+Reference capability: python/ray/data/_internal/logical_ops + planner/
+exchange/. A ``Stage`` is a LOGICAL description of a transformation;
+``ray_tpu.data.execution.planner`` compiles stages into physical operators
+and ``execution.streaming_executor.StreamingExecutor`` runs them with
+per-operator budgets and backpressure. The old flat per-stage in-flight
+window (``_iter_completed``) is gone — pacing decisions live in the
+executor's scheduling loop now, not in each stage.
 
-- each logical stage becomes a pipelined pool of remote tasks over block
-  refs; a stage keeps at most ``max_in_flight`` tasks outstanding
-  (concurrency-cap backpressure, the reference's
-  ConcurrencyCapBackpressurePolicy) and yields output refs as they finish
-  — downstream stages consume while upstream still produces;
-- blocks live in the object store; only ObjectRefs flow between stages
-  (RefBundle equivalent);
-- actor-pool stages (class-based map_batches) reuse stateful actors.
-"""
+The all-to-all stages (repartition/shuffle/sort/aggregate/zip) keep their
+``execute(inputs) -> Iterator[ObjectRef]`` methods: that generator IS the
+distributed exchange (split map tasks + reduce tasks; block data never
+touches the driver), and the physical ``AllToAllOp`` drives it one output
+block per scheduling step."""
 
 from __future__ import annotations
 
-import collections
-import threading
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Iterator, List, Optional
 
 import ray_tpu
 from ray_tpu.core.object_ref import ObjectRef
@@ -26,148 +24,39 @@ from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("data.executor")
 
-DEFAULT_MAX_IN_FLIGHT = 4
-
-
-def _memory_budget_bytes() -> int:
-    from ray_tpu.core.config import config
-
-    return int(config.object_store_memory_bytes * config.data_memory_fraction)
-
-
-def _iter_completed(submit_iter: Iterator[ObjectRef], max_in_flight: int,
-                    preserve_order: bool = True,
-                    budget_bytes: Optional[int] = None) -> Iterator[ObjectRef]:
-    """Pipelines task submission: keeps up to max_in_flight outstanding,
-    yields refs once complete (in submission order when preserve_order).
-
-    ``budget_bytes`` adds byte-budget backpressure (reference:
-    execution/resource_manager.py + streaming_executor_state.py:527 budget-
-    aware op selection): the submit iterator may yield ``(ref, size_hint)``
-    tuples (size of the task's INPUT block — a good output proxy); when
-    in-flight hinted bytes exceed the budget, submission pauses until the
-    consumer drains — bounding store pressure instead of racing it."""
-    pending: "collections.deque[ObjectRef]" = collections.deque()
-    in_flight_bytes = 0
-    sizes: Dict[Any, int] = {}
-    exhausted = False
-
-    def over_budget() -> bool:
-        return budget_bytes is not None and in_flight_bytes > budget_bytes
-
-    while True:
-        while (not exhausted and len(pending) < max_in_flight
-               and not over_budget()):
-            try:
-                item = next(submit_iter)
-            except StopIteration:
-                exhausted = True
-                break
-            ref, size = item if isinstance(item, tuple) else (item, None)
-            pending.append(ref)
-            if budget_bytes is not None and size:
-                sizes[ref] = size
-                in_flight_bytes += size
-        if not pending:
-            return
-        if preserve_order:
-            head = pending.popleft()
-            ray_tpu.wait([head], num_returns=1, timeout=None)
-            in_flight_bytes -= sizes.pop(head, 0)
-            yield head
-        else:
-            ready, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=None)
-            ref = ready[0]
-            pending.remove(ref)
-            in_flight_bytes -= sizes.pop(ref, 0)
-            yield ref
-
 
 class Stage:
-    """A transformation of a stream of block refs."""
+    """A logical transformation of a stream of block refs."""
 
     name = "stage"
 
-    def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        raise NotImplementedError
-
 
 class MapStage(Stage):
+    """Row/batch map (task pool, or actor pool when fn_constructor is set).
+    Purely descriptive: execution lives in TaskPoolMapOp/ActorPoolMapOp."""
+
     def __init__(
         self,
         name: str,
         block_fn: Callable,  # Block -> Block (pickled to workers)
-        max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
         num_cpus: float = 1.0,
         fn_constructor: Optional[Callable] = None,  # class-based: actor pool
         concurrency: Optional[int] = None,
     ):
         self.name = name
         self.block_fn = block_fn
-        self.max_in_flight = max_in_flight
         self.num_cpus = num_cpus
         self.fn_constructor = fn_constructor
         self.concurrency = concurrency
 
-    def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        if self.fn_constructor is not None:
-            yield from self._execute_actor_pool(inputs)
-            return
-        block_fn = self.block_fn
 
-        @ray_tpu.remote(num_cpus=self.num_cpus, name=f"data::{self.name}")
-        def apply(block):
-            return block_fn(block)
+class LimitStage(Stage):
+    """First-n-rows truncation; compiles to a LimitOp that short-circuits
+    every upstream operator once satisfied."""
 
-        from ray_tpu import api as _api
-
-        runtime = _api.global_worker().runtime
-
-        def submitted() -> Iterator[Any]:
-            # size hints feed the byte budget; blocks within one dataset are
-            # near-uniform, so probe every 16th block instead of paying one
-            # control RPC per submit
-            est: Optional[int] = None
-            for i, ref in enumerate(inputs):
-                if i % 16 == 0:
-                    try:
-                        est = runtime.object_sizes([ref])[0] or est
-                    except Exception:  # noqa: BLE001
-                        pass
-                yield apply.remote(ref), est
-
-        yield from _iter_completed(submitted(), self.max_in_flight,
-                                   budget_bytes=_memory_budget_bytes())
-
-    def _execute_actor_pool(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        """Stateful transform: a pool of actors (reference:
-        ActorPoolMapOperator with autoscaling pool; fixed size here)."""
-        ctor = self.fn_constructor
-        block_fn = self.block_fn
-        n = max(1, self.concurrency or 2)
-
-        @ray_tpu.remote(num_cpus=self.num_cpus)
-        class _MapWorker:
-            def __init__(self):
-                self.fn = ctor()
-
-            def apply(self, block):
-                return block_fn(block, self.fn)
-
-        from ray_tpu.util.actor_pool import ActorPool
-
-        actors = [_MapWorker.remote() for _ in range(n)]
-        pool = ActorPool(actors)
-        try:
-            for out in pool.map(lambda a, ref: a.apply.remote(ref), inputs):
-                # ActorPool.map yields VALUES; re-put to keep the ref stream
-                yield ray_tpu.put(out)
-        finally:
-            for a in actors:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:  # noqa: BLE001
-                    pass
+    def __init__(self, limit: int):
+        self.name = f"limit({limit})"
+        self.limit = limit
 
 
 def _exchange(inputs: Iterator[ObjectRef], num_outputs: Optional[int],
@@ -504,92 +393,3 @@ def _locate(counts: List[int], global_row: int):
             return i, global_row - acc
         acc += c
     raise IndexError(global_row)
-
-
-class StageStats:
-    """Per-stage execution statistics (reference: _internal/stats.py
-    DatasetStats — wall time, block count, rows; collected at the stage
-    boundaries the executor already owns)."""
-
-    def __init__(self, name: str):
-        self.name = name
-        self.wall_s = 0.0
-        self.blocks_out = 0
-        self.rows_out = 0
-
-    def row(self) -> Dict[str, Any]:
-        return {"stage": self.name, "wall_s": round(self.wall_s, 4),
-                "blocks": self.blocks_out, "rows": self.rows_out}
-
-
-class StreamingExecutor:
-    def __init__(self, stages: List[Stage], collect_rows: bool = False):
-        self.stages = stages
-        self.stats: List[StageStats] = []
-        # row counting requires a driver-side metadata peek per block; off by
-        # default on the hot path, on for Dataset.stats() runs
-        self._collect_rows = collect_rows
-
-    def _wrap(self, stage: Stage, stream: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        import time as _time
-
-        st = StageStats(stage.name)
-        self.stats.append(st)
-
-        class _TimedUpstream:
-            """Accounts time spent pulling from upstream so a stage's wall_s
-            is ITS OWN work, not the cumulative pipeline time (pull-based
-            chains execute upstream inside downstream's next())."""
-
-            def __init__(self, it):
-                self.it = iter(it)
-                self.time_in_next = 0.0
-
-            def __iter__(self):
-                return self
-
-            def __next__(self):
-                t0 = _time.perf_counter()
-                try:
-                    return next(self.it)
-                finally:
-                    self.time_in_next += _time.perf_counter() - t0
-
-        upstream = _TimedUpstream(stream)
-
-        def gen() -> Iterator[ObjectRef]:
-            it = stage.execute(upstream)
-            while True:
-                mark = upstream.time_in_next
-                t0 = _time.perf_counter()
-                try:
-                    ref = next(it)
-                except StopIteration:
-                    st.wall_s += (_time.perf_counter() - t0) - (
-                        upstream.time_in_next - mark)
-                    return
-                st.wall_s += (_time.perf_counter() - t0) - (
-                    upstream.time_in_next - mark)
-                st.blocks_out += 1
-                if self._collect_rows:
-                    try:
-                        st.rows_out += ray_tpu.get(ref).num_rows
-                    except Exception:  # noqa: BLE001
-                        pass
-                yield ref
-
-        return gen()
-
-    def execute(self, source: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        stream = source
-        for stage in self.stages:
-            stream = self._wrap(stage, stream)
-        return stream
-
-    def summary(self) -> str:
-        lines = [f"{'stage':<28}{'wall_s':>10}{'blocks':>8}{'rows':>10}"]
-        for st in self.stats:
-            r = st.row()
-            lines.append(f"{r['stage']:<28}{r['wall_s']:>10}{r['blocks']:>8}"
-                         f"{r['rows'] if self._collect_rows else '-':>10}")
-        return "\n".join(lines)

@@ -5,36 +5,23 @@ execute in parallel on the cluster)."""
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 import ray_tpu
-from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.data.block import block_from_batch, block_from_rows
 from ray_tpu.data.dataset import Dataset
 
 
 def _parallel_read(make_tasks: List[Callable[[], Any]], name: str) -> Dataset:
-    """Each thunk becomes a remote read task producing one block."""
+    """Each thunk becomes a remote read task producing one block. The
+    streaming executor's InputData operator owns submission pacing
+    (concurrency cap + memory budget), so reads never race ahead of the
+    consumer."""
+    from ray_tpu.data.execution.interfaces import ReadTaskSource
 
-    import builtins
-
-    def source() -> Iterator[ObjectRef]:
-        @ray_tpu.remote(num_cpus=1, name=f"data::read_{name}")
-        def read_one(idx: int):
-            return make_tasks[idx]()
-
-        from ray_tpu.data.executor import DEFAULT_MAX_IN_FLIGHT, _iter_completed
-
-        def submitted():
-            # builtins.range: this module defines its own `range` dataset API
-            for i in builtins.range(len(make_tasks)):
-                yield read_one.remote(i)
-
-        yield from _iter_completed(submitted(), DEFAULT_MAX_IN_FLIGHT)
-
-    return Dataset(source)
+    return Dataset(ReadTaskSource(make_tasks, name))
 
 
 def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001

@@ -123,6 +123,34 @@ def pytest_sessionfinish(session, exitstatus):
                      name="exit-watchdog").start()
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _arena_leak_guard():
+    """Post-suite shm hygiene check: fail LOUDLY if the run leaves orphaned
+    rtpu-arena-* files behind (a SIGKILLed test cluster whose janitor never
+    ran — the live leak VERDICT r5 found pinning /dev/shm). Scoped to arenas
+    that appeared DURING this run whose owner is dead, so concurrent suites
+    on the same box don't trip each other."""
+    import glob
+
+    pre = set(glob.glob("/dev/shm/rtpu-arena-*"))
+    yield
+    try:
+        from ray_tpu.core.shm_store import find_orphan_arenas
+    except Exception:
+        return
+    orphans = [p for p in find_orphan_arenas() if p not in pre]
+    if orphans:
+        # reclaim them (next run must start clean), then fail the suite
+        from ray_tpu.core.shm_store import sweep_dead_arenas
+
+        sweep_dead_arenas()
+        raise RuntimeError(
+            f"ORPHANED SHM ARENAS after test run: {orphans} — a test killed "
+            "a cluster without its startup janitor ever running. The files "
+            "were reclaimed now, but the leaking test must be fixed."
+        )
+
+
 @pytest.fixture
 def ray_tpu_local():
     """Fresh local runtime per test (analogue of the reference's

@@ -98,6 +98,19 @@ def main() -> None:
         if steps_done >= args.steps:
             break
     wall = time.perf_counter() - t0
+
+    # per-op stats of the pipeline execution (new streaming executor):
+    # data-pipeline regressions show up here — which operator starved, how
+    # deep its queues ran — not just in the headline images/s
+    per_op = ds.stats_rows()
+    peak_blocks = None
+    executor = getattr(ds, "_last_executor", None)
+    if executor is not None:
+        peak_blocks = executor.peak_total_blocks
+        from ray_tpu.data.execution.stats import format_stats_table
+
+        print("-- per-op pipeline stats --", file=sys.stderr)
+        print(format_stats_table(per_op, collect_rows=False), file=sys.stderr)
     ray_tpu.shutdown()
 
     result = {
@@ -112,6 +125,8 @@ def main() -> None:
         "model_params": config.num_params,
         "image_size": side,
         "on_tpu": on_tpu,
+        "per_op_stats": per_op,
+        "peak_in_flight_blocks": peak_blocks,
     }
     print(json.dumps(result))
     if args.out:
