@@ -116,12 +116,72 @@ class ClusterRuntime(CoreRuntime):
         self._submit_window = 64
         self._submit_lock = threading.Lock()  # user threads may race get()/remote()
         self._shutting_down = False
+        # ---- pipelined control plane (ISSUE r06) ----
+        from ray_tpu.core.config import inline_max_bytes, pipeline_enabled
+
+        self.pipelined = pipeline_enabled()
+        self._inline_max = inline_max_bytes()
+        # submission coalescing: specs buffer here and flush as ONE
+        # submit_task_batch RPC by size or a ~1 ms window
+        self._submit_buf: List[Dict[str, Any]] = []
+        self._submit_buf_bytes = 0
+        self._submit_event = threading.Event()
+        self._submit_flusher: Optional[threading.Thread] = None
+        self.submit_batches_sent = 0   # observability + tests
+        self.tasks_submitted = 0
+        # inline completion cache: results that NEVER touched the arena
+        # (actor-call replies under the inline threshold). Entries live until
+        # the local ref is released; passing such a ref onward promotes the
+        # payload to the agent first (_promote_inline).
+        # _seal_cond guards BOTH dicts and wakes get()/wait() on any push.
+        self._seal_cond = threading.Condition()
+        self._inline_cache: Dict[str, Dict[str, Any]] = {}
+        self._inline_promoted: set = set()
+        # pushed seal events from the GCS (sealed:{client_id} channel):
+        # object located cluster-wide, possibly with an in-band small payload
+        self._sealed_events: Dict[str, Dict[str, Any]] = {}
+        # return ids of in-flight pipelined actor calls: their completions
+        # arrive through the reply/push channel (possibly inline-only, never
+        # registered at the GCS), so get() must keep waiting on the channel
+        # instead of falling back to the ensure path for them
+        self._pending_actor_returns: set = set()
+        # return ids of submitted-not-yet-sealed tasks: get() expects pushed
+        # completions for these and stays RPC-free while they stream in;
+        # ids NOT here (puts, borrowed refs) go straight to the ensure path
+        self._pending_task_returns: Dict[str, bool] = {}
+        self._actor_pipelines: Dict[str, "_ActorPipeline"] = {}
+        # batched actor-call ref pins/unpins: one FIFO thread preserves
+        # pin-before-unpin order per task while coalescing into pin_tasks/
+        # unpin_tasks RPCs (the lockstep path pays one GCS round trip per
+        # call for each)
+        self._refop_buf: List[Tuple[str, Dict[str, Any]]] = []
+        self._refop_event = threading.Event()
+        self._refop_thread: Optional[threading.Thread] = None
+        if self.pipelined:
+            self._submit_flusher = threading.Thread(
+                target=self._submit_flush_loop, daemon=True,
+                name=f"submit-flush-{self.client_id[2:10]}")
+            self._submit_flusher.start()
+            self._refop_thread = threading.Thread(
+                target=self._refop_flush_loop, daemon=True,
+                name=f"refop-flush-{self.client_id[2:10]}")
+            self._refop_thread.start()
+            try:
+                self.gcs.subscribe(f"sealed:{self.client_id}",
+                                   self._on_sealed_event)
+            except Exception:  # noqa: BLE001 - pushes are an optimization;
+                # get()/wait() fall back to the polling paths without them
+                logger.warning("sealed-event subscription failed", exc_info=True)
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
         w = global_worker()
         oid = w.next_put_id()
         payload, refs = serialization.pack(value)
+        if self.pipelined and refs:
+            # refs nested inside the stored value escape this process with
+            # the container: materialize any inline-only values first
+            self._promote_inline([r.id.hex() for r in refs])
         self._queue_ref_op("add", oid.hex())  # this process holds the new ref
         if len(payload) <= config.max_direct_call_object_size:
             # small object: one round trip (agent writes the shm segment)
@@ -129,6 +189,19 @@ class ClusterRuntime(CoreRuntime):
                 "put_object", object_id=oid.hex(), payload=bytes(payload),
                 contained=[r.id.hex() for r in refs] or None,
             )
+            if self.pipelined and len(payload) <= self._inline_max:
+                # the putter already HAS the bytes: cache them so a local
+                # get() is a dict lookup, no RPC and no arena read. Marked
+                # promoted — the value is sealed in the arena already.
+                with self._seal_cond:
+                    self._inline_cache[oid.hex()] = {
+                        "object_id": oid.hex(), "payload": bytes(payload),
+                        "is_error": False,
+                        "contained": [r.id.hex() for r in refs] or None,
+                    }
+                    self._inline_promoted.add(oid.hex())
+                    self._seal_cond.notify_all()
+                self._evict_inline_overflow()
             return ObjectRef(oid)
         if self.remote_data_plane:
             # CLIENT MODE (reference: ray:// Ray Client proxied data plane):
@@ -220,16 +293,183 @@ class ClusterRuntime(CoreRuntime):
             finally:
                 reader.close()
         if is_error:
-            err = value
-            if isinstance(err, dict) and "__rtpu_error__" in err:
-                # cross-language (xlang) error envelope from a non-Python
-                # submitter's task (see worker_main._store_error_returns)
-                raise exc.TaskError(err.get("__rtpu_error__", "?"),
-                                    err.get("message", ""))
-            if isinstance(err, exc.TaskError):
-                raise err.as_instanceof_cause()
-            raise err
+            self._raise_error_value(value)
         return value
+
+    @staticmethod
+    def _raise_error_value(err: Any) -> None:
+        if isinstance(err, dict) and "__rtpu_error__" in err:
+            # cross-language (xlang) error envelope from a non-Python
+            # submitter's task (see worker_main._store_error_returns)
+            raise exc.TaskError(err.get("__rtpu_error__", "?"),
+                                err.get("message", ""))
+        if isinstance(err, exc.TaskError):
+            raise err.as_instanceof_cause()
+        raise err
+
+    def _unpack_payload(self, payload: bytes, is_error: bool) -> Any:
+        """Materialize a result from an INLINE payload (actor-call reply or
+        pushed seal event) — same semantics as _read_local, no arena."""
+        value = serialization.unpack(memoryview(payload), zero_copy=False)
+        if is_error:
+            self._raise_error_value(value)
+        return value
+
+    # ------------------------------------------------- pipelined completions
+    def _on_sealed_event(self, msg: Any) -> None:
+        """Pushed seals from the GCS (this process holds the objects): one
+        frame carries every seal of a registration batch. Record them and
+        wake parked get()/wait() ONCE. Runs on the GCS client's loop
+        thread — must never block."""
+        try:
+            events = msg.get("events") or []
+            with self._seal_cond:
+                for ev in events:
+                    h = ev.get("object_id")
+                    if not h:
+                        continue
+                    self._pending_task_returns.pop(h, None)
+                    self._sealed_events[h] = ev
+                while len(self._sealed_events) > 20000:
+                    # events are an optimization: evicting one costs a
+                    # fallback RPC, never correctness (the object itself
+                    # lives in the arena)
+                    self._sealed_events.pop(next(iter(self._sealed_events)))
+                self._seal_cond.notify_all()
+        except Exception:  # noqa: BLE001 - a bad frame must not kill pubsub
+            logger.exception("sealed-event handler failed")
+
+    def _absorb_inline(self, reply: Any) -> None:
+        """Cache inline results from an actor-call completion. These values
+        exist NOWHERE else (the worker skipped the arena write); they are
+        promoted to the agent's store the moment the ref could escape this
+        process, or when the cache overflows."""
+        inline = (reply or {}).get("inline_returns") or []
+        if not inline:
+            return
+        with self._seal_cond:
+            for item in inline:
+                self._inline_cache[item["object_id"]] = item
+            self._seal_cond.notify_all()
+        self._evict_inline_overflow()
+
+    def _evict_inline_overflow(self, cap: int = 8192) -> None:
+        """Bound the inline cache: already-promoted entries (puts, passed-on
+        results) just drop; inline-only entries are promoted to the agent's
+        store first so the value survives eviction."""
+        with self._seal_cond:
+            extra = len(self._inline_cache) - cap
+            if extra <= 0:
+                return
+            overflow = list(self._inline_cache)[:extra]
+            droppable = [h for h in overflow if h in self._inline_promoted]
+            to_promote = [h for h in overflow if h not in self._inline_promoted]
+            for h in droppable:
+                self._inline_cache.pop(h, None)
+                self._inline_promoted.discard(h)
+        if to_promote:
+            try:
+                self._promote_inline(to_promote)
+            except Exception:  # noqa: BLE001 - entries stay cached; retry later
+                logger.exception("inline-cache overflow promotion failed")
+            else:
+                with self._seal_cond:
+                    for h in to_promote:
+                        self._inline_cache.pop(h, None)
+                        self._inline_promoted.discard(h)
+
+    def _promote_inline(self, ids: Sequence[str]) -> None:
+        """Write inline-cached results into the agent's store (idempotent).
+        Called before a ref escapes this process (task/actor-call argument,
+        nested inside a put) so the cluster can serve the value to anyone
+        else who may hold the ref."""
+        for h in ids:
+            with self._seal_cond:
+                ent = self._inline_cache.get(h)
+                if ent is None or h in self._inline_promoted:
+                    continue
+                self._inline_promoted.add(h)
+            try:
+                self.agent.call(
+                    "put_object", object_id=h, payload=ent["payload"],
+                    owner=ent.get("owner") or "",
+                    is_error=bool(ent.get("is_error")),
+                    contained=ent.get("contained"),
+                )
+            except Exception:
+                with self._seal_cond:
+                    self._inline_promoted.discard(h)
+                raise
+
+    def _drop_cached_result(self, oid_hex: str) -> None:
+        with self._seal_cond:
+            self._inline_cache.pop(oid_hex, None)
+            self._inline_promoted.discard(oid_hex)
+            self._sealed_events.pop(oid_hex, None)
+            self._pending_task_returns.pop(oid_hex, None)
+
+    # ------------------------------------------------ batched pins/unpins
+    def _queue_refop(self, kind: str, payload: Dict[str, Any]) -> None:
+        with self._ref_lock:
+            self._refop_buf.append((kind, payload))
+        self._refop_event.set()
+
+    def _refop_flush_loop(self) -> None:
+        while not self._ref_stop.is_set():
+            if not self._refop_event.wait(timeout=0.5):
+                continue
+            self._refop_event.clear()
+            time.sleep(config.submit_batch_window_ms / 1000.0)
+            try:
+                self._flush_refops()
+            except Exception:  # noqa: BLE001 - advisory bookkeeping
+                logger.exception("actor pin/unpin flush failed")
+
+    def _flush_refops(self) -> None:
+        """Drain queued actor-call pins/unpins into batched GCS RPCs,
+        preserving order (a task's unpin is enqueued strictly after its pin,
+        and consecutive same-kind runs coalesce — same scheme as
+        flush_refs)."""
+        with self._ref_lock:
+            ops, self._refop_buf = self._refop_buf, []
+        if not ops:
+            return
+        i = 0
+        while i < len(ops):
+            kind = ops[i][0]
+            j = i
+            while j < len(ops) and ops[j][0] == kind:
+                j += 1
+            batch = [p for _, p in ops[i:j]]
+            self.gcs.call("pin_tasks" if kind == "pin" else "unpin_tasks",
+                          **({"pins": batch} if kind == "pin"
+                             else {"unpins": batch}))
+            i = j
+
+    def _actor_returns_done(self, sd: Dict[str, Any]) -> None:
+        """An actor call fully completed (inline absorbed / arena stored /
+        error objects materialized): its returns may now resolve through the
+        normal fallback paths."""
+        returns = sd.get("returns") or []
+        if not returns:
+            return
+        with self._seal_cond:
+            self._pending_actor_returns.difference_update(returns)
+            self._seal_cond.notify_all()
+
+    def _resolve_cached(self, oid_hex: str, resolved: Dict[str, Any]) -> bool:
+        """Serve one id from the inline cache or a pushed payload; raises for
+        error results (same contract as the arena read)."""
+        with self._seal_cond:
+            ent = self._inline_cache.get(oid_hex)
+            if ent is None:
+                ev = self._sealed_events.get(oid_hex)
+                if ev is None or "payload" not in ev:
+                    return False
+                ent = ev
+        resolved[oid_hex] = self._unpack_payload(ent["payload"],
+                                                 bool(ent.get("is_error")))
+        return True
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         if not refs:
@@ -237,65 +477,154 @@ class ClusterRuntime(CoreRuntime):
         self._barrier_submit_acks()
         blocked = self._notify_blocked(True)
         try:
-            # One batched RPC: the agent pulls every object concurrently
-            # (reference: plasma batched Get, src/ray/core_worker/
-            # store_provider/plasma_store_provider.cc). Issued in bounded
-            # chunks and re-sent on RPC timeout (ensure_local is idempotent),
-            # so one dropped frame doesn't consume the whole user deadline —
-            # and a timeout=None get still survives connection hiccups.
             deadline = None if timeout is None else time.monotonic() + timeout
             ids = [r.id.hex() for r in refs]
-            while True:
-                remaining = None if deadline is None else deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    raise exc.GetTimeoutError(
-                        f"get() timed out waiting for {len(refs)} objects"
-                    )
-                # short chunks: ensure_local can't distinguish "frame
-                # dropped" from "object not ready yet", so a small window
-                # bounds what one lost frame costs; re-issue is idempotent
-                attempt_s = 5.0 if remaining is None else min(remaining, 5.0)
-                try:
-                    infos = self.agent.call(
-                        "ensure_local_batch", object_ids=ids,
-                        timeout=attempt_s + 5.0, timeout_s=attempt_s,
-                    )
-                except TimeoutError:
+            resolved: Dict[str, Any] = {}
+            todo: List[str] = []
+            seen: set = set()
+            for h in ids:
+                if h in seen:
                     continue
-                if any(i.get("error_type") == "TimeoutError" for i in infos) and (
-                    remaining is None or remaining > attempt_s
-                ):
-                    continue  # per-object timeout but user deadline remains
-                break
-            out = []
-            for ref, info in zip(refs, infos):
-                if "error" in info:
-                    if info.get("error_type") == "TimeoutError":
-                        raise exc.GetTimeoutError(
-                            f"get() timed out waiting for {ref.id.hex()[:16]}"
-                        )
-                    raise exc.ObjectLostError(ref.id.hex(), info["error"])
-                for attempt in range(4):
-                    try:
-                        out.append(self._read_local(ref.id, info["size"],
-                                                    info["is_error"],
-                                                    offset=info.get("offset")))
-                        break
-                    except FileNotFoundError:
-                        # arena slot evicted between the metadata reply and
-                        # the copy (or mid-copy): the object may still live
-                        # in spill — re-ensure and retry with fresh metadata
-                        if attempt == 3:
-                            raise exc.ObjectLostError(
-                                ref.id.hex(), "evicted repeatedly during read")
-                        info = self.agent.call(
-                            "ensure_local", object_id=ref.id.hex(),
-                            timeout_s=10.0, timeout=15.0,
-                        )
+                seen.add(h)
+                if not (self.pipelined and self._resolve_cached(h, resolved)):
+                    todo.append(h)
+            if todo and self.pipelined:
+                # push phase: completions stream in over the sealed-event
+                # channel (and actor-call replies); zero RPCs while they flow
+                todo = self._await_pushed(todo, deadline, resolved)
+            if todo:
+                self._get_via_ensure(todo, deadline, resolved)
+            return [resolved[h] for h in ids]
         finally:
             if blocked:
                 self._notify_blocked(False)
-        return out
+
+    def _await_pushed(self, todo: List[str], deadline: Optional[float],
+                      resolved: Dict[str, Any]) -> List[str]:
+        """Block on pushed completions for ids we EXPECT pushes for — our
+        own submitted task returns and in-flight actor calls. Everything
+        else (puts, borrowed refs, objects sealed before this process held
+        them) never pushes, so it goes straight to the ensure+read path.
+        A stall with zero progress also falls back (lost pushes cost
+        latency, never correctness — the ensure loop re-checks the inline
+        cache, so even inline-only completions landing late are served).
+        Returns the ids still needing the ensure+read path."""
+        pending = set(todo)
+        with self._seal_cond:
+            if not any(h in self._pending_task_returns
+                       or h in self._pending_actor_returns
+                       for h in pending):
+                return list(todo)
+        last_progress = time.monotonic()
+        while pending:
+            # one lock acquisition per wake: scan, else wait — a per-id lock
+            # dance here measurably starves the (co-located) control plane
+            found: List[Tuple[str, bytes, bool]] = []
+            give_up = False
+            with self._seal_cond:
+                while True:
+                    for h in list(pending):
+                        ent = self._inline_cache.get(h)
+                        if ent is None:
+                            ev = self._sealed_events.get(h)
+                            if ev is None or "payload" not in ev:
+                                continue
+                            ent = ev
+                        found.append((h, ent["payload"],
+                                      bool(ent.get("is_error"))))
+                        pending.discard(h)
+                    if found or not pending:
+                        break
+                    if all(h in self._sealed_events for h in pending):
+                        give_up = True  # all located — read via the agent
+                        break
+                    if not any(h in self._pending_task_returns
+                               or h in self._pending_actor_returns
+                               for h in pending):
+                        # every remaining completion already landed (or was
+                        # never expected): the store has whatever exists
+                        give_up = True
+                        break
+                    now = time.monotonic()
+                    if now - last_progress > 3.0:
+                        give_up = True  # stalled: polling path takes over
+                        break
+                    remaining = None if deadline is None else deadline - now
+                    if remaining is not None and remaining <= 0:
+                        give_up = True  # ensure path raises GetTimeoutError
+                        break
+                    chunk = 0.25 if remaining is None else min(0.25, remaining)
+                    self._seal_cond.wait(chunk)
+            for h, payload, is_error in found:
+                resolved[h] = self._unpack_payload(payload, is_error)
+            if found:
+                last_progress = time.monotonic()
+            if give_up:
+                break
+        return [h for h in todo if h in pending]
+
+    def _get_via_ensure(self, ids: List[str], deadline: Optional[float],
+                        resolved: Dict[str, Any]) -> None:
+        # One batched RPC: the agent pulls every object concurrently
+        # (reference: plasma batched Get, src/ray/core_worker/
+        # store_provider/plasma_store_provider.cc). Issued in bounded
+        # chunks and re-sent on RPC timeout (ensure_local is idempotent),
+        # so one dropped frame doesn't consume the whole user deadline —
+        # and a timeout=None get still survives connection hiccups.
+        while True:
+            if self.pipelined:
+                # a pushed completion may land while we poll — and an
+                # inline-only actor result NEVER appears in the store, so
+                # this re-check is what ultimately serves it here
+                ids = [h for h in ids if not self._resolve_cached(h, resolved)]
+                if not ids:
+                    return
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {len(ids)} objects"
+                )
+            # short chunks: ensure_local can't distinguish "frame
+            # dropped" from "object not ready yet", so a small window
+            # bounds what one lost frame costs; re-issue is idempotent
+            attempt_s = 5.0 if remaining is None else min(remaining, 5.0)
+            try:
+                infos = self.agent.call(
+                    "ensure_local_batch", object_ids=ids,
+                    timeout=attempt_s + 5.0, timeout_s=attempt_s,
+                )
+            except TimeoutError:
+                continue
+            if any(i.get("error_type") == "TimeoutError" for i in infos) and (
+                remaining is None or remaining > attempt_s
+            ):
+                continue  # per-object timeout but user deadline remains
+            break
+        for h, info in zip(ids, infos):
+            if "error" in info:
+                if info.get("error_type") == "TimeoutError":
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {h[:16]}"
+                    )
+                raise exc.ObjectLostError(h, info["error"])
+            oid = ObjectID.from_hex(h)
+            for attempt in range(4):
+                try:
+                    resolved[h] = self._read_local(oid, info["size"],
+                                                   info["is_error"],
+                                                   offset=info.get("offset"))
+                    break
+                except FileNotFoundError:
+                    # arena slot evicted between the metadata reply and
+                    # the copy (or mid-copy): the object may still live
+                    # in spill — re-ensure and retry with fresh metadata
+                    if attempt == 3:
+                        raise exc.ObjectLostError(
+                            h, "evicted repeatedly during read")
+                    info = self.agent.call(
+                        "ensure_local", object_id=h,
+                        timeout_s=10.0, timeout=15.0,
+                    )
 
     def _notify_blocked(self, blocked: bool) -> bool:
         """Within a worker: tell the agent this worker is blocked in get()
@@ -316,10 +645,74 @@ class ClusterRuntime(CoreRuntime):
     def wait(self, refs, num_returns, timeout, fetch_local):
         self._barrier_submit_acks()
         ids = [r.id.hex() for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if self.pipelined:
+            ready_set = self._wait_pushed(ids, num_returns, deadline)
+        else:
+            ready_set = self._wait_via_rpc(ids, num_returns, deadline)
+        ready, not_ready = [], []
+        for r in refs:
+            if r.id.hex() in ready_set and len(ready) < num_returns:
+                ready.append(r)
+            else:
+                not_ready.append(r)
+        return ready, not_ready
+
+    def _wait_pushed(self, ids: List[str], num_returns: int,
+                     deadline: Optional[float]) -> set:
+        """Push-driven wait: a remote seal wakes us through the sealed-event
+        channel with NO polling; a stall (lost push, or the object sealed
+        before this process became a holder) falls back to one bounded
+        wait_objects RPC per chunk — latency cost only, never correctness."""
+        needed = min(num_returns, len(ids))
+        ready: set = set()
+
+        def _scan() -> None:
+            for h in ids:
+                if h in self._inline_cache or h in self._sealed_events:
+                    ready.add(h)
+
+        while True:
+            with self._seal_cond:
+                _scan()
+                if len(ready) >= needed:
+                    return ready
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return ready
+                chunk = 0.5 if remaining is None else min(0.5, remaining)
+                self._seal_cond.wait(chunk)
+                progressed = len(ready) < needed and any(
+                    h in self._inline_cache or h in self._sealed_events
+                    for h in ids if h not in ready
+                )
+            if progressed:
+                continue  # pushes are resolving OUR ids: stay RPC-free
+            # no progress this chunk (lost push, or the object sealed before
+            # this process became a holder): one bounded wait_objects RPC —
+            # itself event-driven at the GCS, so this is a safety net, not a
+            # hot poll
+            pending = [h for h in ids if h not in ready]
+            remaining = None if deadline is None else deadline - time.monotonic()
+            attempt_s = 2.0 if remaining is None else max(0.0, min(remaining, 2.0))
+            try:
+                ready.update(self.agent.call(
+                    "wait_objects", object_ids=pending,
+                    num_returns=needed - len(ready),
+                    timeout=attempt_s + 10.0, timeout_s=attempt_s,
+                ))
+            except TimeoutError:
+                pass
+            if len(ready) >= needed:
+                return ready
+            if remaining is not None and remaining <= attempt_s:
+                return ready
+
+    def _wait_via_rpc(self, ids: List[str], num_returns: int,
+                      deadline: Optional[float]) -> set:
         # bounded chunks, like get(): one infinite RPC would hang forever if
         # its response frame is lost (agent restart, connection blip) — a
         # re-sent wait is idempotent
-        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
             attempt_s = 10.0 if remaining is None else max(0.0, min(remaining, 10.0))
@@ -337,12 +730,12 @@ class ClusterRuntime(CoreRuntime):
                 break
             if remaining is not None and remaining <= attempt_s:
                 break
-        ready_set = set(ready_ids[:num_returns]) if len(ready_ids) > num_returns else set(ready_ids)
-        ready = [r for r in refs if r.id.hex() in ready_set]
-        not_ready = [r for r in refs if r.id.hex() not in ready_set]
-        return ready, not_ready
+        return (set(ready_ids[:num_returns]) if len(ready_ids) > num_returns
+                else set(ready_ids))
 
     def free(self, refs: Sequence[ObjectRef]) -> None:
+        for r in refs:
+            self._drop_cached_result(r.id.hex())
         self.agent.call("free_objects", object_ids=[r.id.hex() for r in refs])
 
     def object_sizes(self, refs: Sequence[ObjectRef]) -> List[Optional[int]]:
@@ -446,6 +839,7 @@ class ClusterRuntime(CoreRuntime):
         """Local refcount hit zero: withdraw this process's cluster holder.
         The GCS frees the object everywhere once ALL holders (other
         processes, in-flight task pins) are gone plus a grace window."""
+        self._drop_cached_result(oid.hex())
         self._queue_ref_op("del", oid.hex())
 
     # --------------------------------------------------------------- tasks
@@ -493,6 +887,13 @@ class ClusterRuntime(CoreRuntime):
 
     def _spec_dict(self, spec: TaskSpec, args: tuple, kwargs: dict) -> Dict[str, Any]:
         payload, _refs = serialization.pack((args, kwargs))
+        if self.pipelined:
+            # any argument ref whose value lives only in this process's
+            # inline cache must be materialized in the cluster before anyone
+            # else tries to resolve it (top-level deps AND nested refs)
+            self._promote_inline(
+                [d.hex() for d in spec.dependencies()]
+                + [r.id.hex() for r in _refs])
         sd = {
             "runtime_env": self._prepare_runtime_env(spec.runtime_env),
             "task_id": spec.task_id.binary().hex(),
@@ -517,8 +918,23 @@ class ClusterRuntime(CoreRuntime):
         # the agent registers this holder on the returns (and pins deps under
         # a task holder) BEFORE accepting — see agent.rpc_submit_task
         sd["holder"] = self.client_id
-        with self._submit_lock:
-            self._submit_acks.append(self.agent.call_async("submit_task", spec=sd))
+        self.tasks_submitted += 1
+        if self.pipelined:
+            if not spec.generator:
+                # expected pushed completions: get() stays on the channel
+                # for these instead of polling the agent
+                with self._seal_cond:
+                    for r in sd["returns"]:
+                        self._pending_task_returns[r] = True
+                    while len(self._pending_task_returns) > 200000:
+                        self._pending_task_returns.pop(
+                            next(iter(self._pending_task_returns)))
+            # coalescing buffer: specs flush as ONE submit_task_batch RPC by
+            # size or the ~1 ms window (the flusher thread)
+            self._enqueue_submit(sd)
+        else:
+            with self._submit_lock:
+                self._submit_acks.append(self.agent.call_async("submit_task", spec=sd))
         self._reap_submit_acks()
         if spec.generator:
             # dynamic returns: item holders are registered at stream_put time;
@@ -526,6 +942,42 @@ class ClusterRuntime(CoreRuntime):
             # on item 0 and free it before the consumer ever sees it
             return []
         return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def _enqueue_submit(self, sd: Dict[str, Any]) -> None:
+        with self._submit_lock:
+            self._submit_buf.append(sd)
+            self._submit_buf_bytes += len(sd.get("args_payload") or b"")
+            full = (len(self._submit_buf) >= config.submit_batch_max
+                    or self._submit_buf_bytes >= config.submit_batch_max_bytes)
+        if full:
+            self._flush_submits()
+        else:
+            self._submit_event.set()  # arm the window timer
+
+    def _flush_submits(self) -> None:
+        with self._submit_lock:
+            batch, self._submit_buf = self._submit_buf, []
+            self._submit_buf_bytes = 0
+            if not batch:
+                return
+            self._submit_acks.append(
+                self.agent.call_async("submit_task_batch", specs=batch))
+            self.submit_batches_sent += 1
+
+    def _submit_flush_loop(self) -> None:
+        """Window timer: a partial batch flushes ~submit_batch_window_ms
+        after the first spec buffered (size-triggered flushes happen inline
+        on the submitting thread)."""
+        while not self._ref_stop.is_set():
+            if not self._submit_event.wait(timeout=0.5):
+                continue
+            self._submit_event.clear()
+            time.sleep(config.submit_batch_window_ms / 1000.0)
+            try:
+                self._flush_submits()
+            except Exception:  # noqa: BLE001 - flusher must survive; the
+                # barrier path re-flushes and surfaces errors to the caller
+                logger.exception("submit batch flush failed")
 
     def _pop_ack(self, only_done: bool) -> Optional[Any]:
         with self._submit_lock:
@@ -550,6 +1002,8 @@ class ClusterRuntime(CoreRuntime):
         """Wait for every in-flight submit to be accepted (and its deps
         pinned). Called before get()/wait() so a dropped submit surfaces as
         an exception instead of a hang."""
+        if self.pipelined:
+            self._flush_submits()  # buffered specs must join the barrier
         while True:
             fut = self._pop_ack(only_done=False)
             if fut is None:
@@ -626,19 +1080,14 @@ class ClusterRuntime(CoreRuntime):
         sd = self._spec_dict(spec, args, kwargs)
         if spec.generator:
             sd["holder"] = self.client_id
-        # pin deps+returns for the in-flight call (released when the push
-        # completes in _push_actor_task) and register this process's holder on
-        # the returns — synchronously, while the caller's arg refs are live.
+        # pin deps+returns for the in-flight call (released when the call
+        # completes) and register this process's holder on the returns.
         # Client-scoped pin id: reaped with this process's holder lease if we
         # crash before removal.
         sd["task_holder"] = f"task:{sd['task_id']}@{self.client_id}"
-        try:
-            self.gcs.call(
-                "pin_task", task_holder=sd["task_holder"], deps=sd["deps"],
-                returns=sd["returns"], submitter=self.client_id, spec=None,
-            )
-        except Exception:  # noqa: BLE001 - advisory bookkeeping
-            logger.exception("actor-task ref pinning failed")
+        pin_kwargs = dict(task_holder=sd["task_holder"], deps=sd["deps"],
+                          returns=sd["returns"], submitter=self.client_id,
+                          spec=None)
         sd.update(actor_id=actor_id.hex(), method=spec.actor_method_name)
         rec = self._actor_cache.get(actor_id.hex())
         if rec is None:
@@ -650,6 +1099,25 @@ class ClusterRuntime(CoreRuntime):
                 except Exception:  # noqa: BLE001
                     rec = {}
             self._actor_cache[actor_id.hex()] = rec
+        if self.pipelined:
+            # windowed pipelining: the pin rides the batched refop channel
+            # (FIFO — the completion's unpin is enqueued after it and can
+            # never overtake it), results at most the inline threshold ride
+            # back IN the completion reply, and many calls stay in flight
+            # per actor (seq-ordered on the worker side).
+            if not spec.generator:
+                sd["inline_max"] = self._inline_max
+                with self._seal_cond:
+                    self._pending_actor_returns.update(sd["returns"])
+            self._queue_refop("pin", pin_kwargs)
+            self._actor_pipeline(actor_id.hex()).submit(
+                sd, spec.max_task_retries,
+                ordered=rec.get("max_concurrency", 1) <= 1)
+            return refs
+        try:
+            self.gcs.call("pin_task", **pin_kwargs)
+        except Exception:  # noqa: BLE001 - advisory bookkeeping
+            logger.exception("actor-task ref pinning failed")
         if rec.get("max_concurrency", 1) > 1:
             # threaded/async actors: unordered concurrent pushes (reference
             # semantics: ordering is only guaranteed for max_concurrency=1)
@@ -659,6 +1127,16 @@ class ClusterRuntime(CoreRuntime):
             # order end-to-end (ActorSchedulingQueue equivalent)
             self._actor_dispatcher(actor_id.hex()).put((sd, spec.max_task_retries))
         return refs
+
+    def _actor_pipeline(self, actor_hex: str) -> "_ActorPipeline":
+        with self._lock:
+            if self._shutting_down:
+                raise RpcConnectionError("runtime is shut down")
+            p = self._actor_pipelines.get(actor_hex)
+            if p is None:
+                p = _ActorPipeline(self, actor_hex)
+                self._actor_pipelines[actor_hex] = p
+            return p
 
     def _actor_dispatcher(self, actor_hex: str):
         import queue as _q
@@ -706,8 +1184,25 @@ class ClusterRuntime(CoreRuntime):
             try:
                 rec = self._resolve_actor(actor_hex)
                 client = self._actor_client(rec["address"])
-                client.call("run_actor_task", spec=sd, timeout=None)
-                return
+                while True:
+                    try:
+                        client.call("run_actor_task", spec=sd,
+                                    caller=self.client_id,
+                                    timeout=config.actor_call_deadline_s)
+                        return
+                    except TimeoutError:
+                        # Deadline expired: never wedge this dispatcher on a
+                        # hung worker (the old timeout=None did exactly that).
+                        # Probe liveness — an alive worker means the call is
+                        # merely long-running: re-attach (the worker dedupes
+                        # by task_id and piggybacks the running execution). A
+                        # dead worker fails the ping, which lands in the
+                        # retry handler below.
+                        client.call("ping", timeout=5.0)
+                        logger.warning(
+                            "actor call %s exceeded %.0fs; worker alive, "
+                            "re-attaching", sd.get("name"),
+                            config.actor_call_deadline_s)
             except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
                 self._store_error_objects(sd, str(e), "ActorDiedError")
                 return
@@ -811,13 +1306,18 @@ class ClusterRuntime(CoreRuntime):
 
     def shutdown(self) -> None:
         self._ref_stop.set()
+        self._submit_event.set()  # wake the flusher so it observes the stop
         with self._lock:
             self._shutting_down = True
+            pipelines = list(self._actor_pipelines.values())
+        for p in pipelines:
+            p.stop()
         try:
             self._barrier_submit_acks()
         except Exception:  # noqa: BLE001
             pass
         try:
+            self._flush_refops()
             self.flush_refs()
             self.gcs.call("drop_holder", holder=self.client_id)
         except Exception:  # noqa: BLE001
@@ -841,6 +1341,184 @@ class ClusterRuntime(CoreRuntime):
 
     def kv_keys(self, prefix: str = "") -> List[str]:
         return self.gcs.call("kv_keys", prefix=prefix)
+
+
+class _ActorPipeline:
+    """Windowed, seq-numbered pushes to ONE actor over the worker's
+    persistent connection (reference: transport/actor_task_submitter.h —
+    many calls in flight, out-of-order completion, per-actor order preserved
+    by the worker's seq gate; the old design held ONE blocking call per
+    dispatcher thread with an infinite deadline).
+
+    Flow: user threads enqueue; the dispatcher thread resolves the actor,
+    stamps a seq (ordered actors), and fires call_async bounded by the
+    window semaphore. Completions land on the RPC client's loop thread and
+    are immediately handed to the runtime's background pool (absorb inline
+    results, release pins, or route failures back through this queue).
+    Deadline expiries probe worker liveness: alive workers mean a merely
+    long-running call (re-attach; the worker dedupes by task_id), dead ones
+    route through the retry path — a hung worker can no longer wedge the
+    dispatcher forever."""
+
+    def __init__(self, runtime: "ClusterRuntime", actor_hex: str):
+        import queue as _q
+
+        self.rt = runtime
+        self.actor_hex = actor_hex
+        self.q: "_q.Queue" = _q.Queue()
+        self.window = threading.Semaphore(max(1, int(config.actor_call_window)))
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._client: Optional[SyncRpcClient] = None  # cached route
+        self.calls_pushed = 0  # observability
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"actor-pipe-{actor_hex[:8]}")
+        self._thread.start()
+
+    def submit(self, sd: Dict[str, Any], retries: int,
+               ordered: bool = True) -> None:
+        if ordered:
+            with self._seq_lock:
+                sd["seq"] = self._seq
+                self._seq += 1
+        self.q.put(("dispatch", sd, retries, 0))
+
+    def stop(self) -> None:
+        self.q.put(None)
+
+    def _loop(self) -> None:
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            kind, sd, retries, attempts = item
+            try:
+                if kind == "probe":
+                    self._probe(sd, retries, attempts)
+                else:
+                    self._dispatch(sd, retries, attempts)
+            except Exception:  # noqa: BLE001 - the pipeline must survive
+                logger.exception("actor pipeline dispatch failed")
+                self._finish(sd)
+
+    def _get_client(self) -> SyncRpcClient:
+        """Resolve-once routing: the worker address is cached so steady-state
+        dispatch costs ZERO control RPCs (one get_actor per call serialized
+        the old dispatcher); any failure invalidates the cache and the retry
+        re-resolves (actor restarts land on the new address)."""
+        if self._client is None:
+            rec = self.rt._resolve_actor(self.actor_hex)
+            self._client = self.rt._actor_client(rec["address"])
+        return self._client
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, sd: Dict[str, Any], retries: int, attempts: int) -> None:
+        rt = self.rt
+        try:
+            client = self._get_client()
+        except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
+            self._fail(sd, str(e), "ActorDiedError")
+            return
+        except (ConnectionError, RpcError, TimeoutError) as e:
+            self._retry_or_fail(sd, retries, attempts + 1, e)
+            return
+        self.window.acquire()  # backpressure: at most `window` in flight
+        try:
+            fut = client.call_async(
+                "run_actor_task", spec=sd, seq=sd.get("seq"),
+                caller=rt.client_id, timeout=config.actor_call_deadline_s)
+        except Exception as e:  # noqa: BLE001 - client closed under us
+            self.window.release()
+            self._client = None
+            self._retry_or_fail(sd, retries, attempts + 1, e)
+            return
+        self.calls_pushed += 1
+        fut.add_done_callback(
+            lambda f: self._on_done(f, sd, retries, attempts))
+
+    def _on_done(self, fut: Any, sd: Dict[str, Any], retries: int,
+                 attempts: int) -> None:
+        # runs on the RPC client's event-loop thread: release the window
+        # first; the success path is non-blocking (cache writes + queued
+        # unpin), failures go to the background pool (they may sleep/RPC)
+        self.window.release()
+        try:
+            reply = fut.result()
+        except BaseException as e:  # noqa: BLE001
+            self._submit_bg(self._handle_failure, sd, retries, attempts, e)
+            return
+        try:
+            self.rt._absorb_inline(reply)
+        except Exception:  # noqa: BLE001
+            logger.exception("inline absorb failed")
+        self._finish(sd)
+
+    def _submit_bg(self, fn, *args) -> None:
+        try:
+            self.rt._bg.submit(fn, *args)
+        except RuntimeError:  # pool shut down mid-flight
+            pass
+
+    # ------------------------------------------------------------ failures
+    def _handle_failure(self, sd: Dict[str, Any], retries: int, attempts: int,
+                        e: BaseException) -> None:
+        if isinstance(e, TimeoutError):
+            # deadline expired with the connection healthy: probe liveness
+            # on the dispatcher before deciding (long-running user methods
+            # are legitimate and must survive)
+            self.q.put(("probe", sd, retries, attempts))
+            return
+        if isinstance(e, RpcError) and e.remote_type not in (
+            "ConnectionError", "RpcConnectionError", "ActorDiedError",
+        ):
+            # handler-level error: results already stored as error objects
+            self._finish(sd)
+            return
+        self._client = None  # route may be stale (worker died/restarted)
+        self._retry_or_fail(sd, retries, attempts + 1, e)
+
+    def _probe(self, sd: Dict[str, Any], retries: int, attempts: int) -> None:
+        try:
+            self._get_client().call("ping", timeout=5.0)
+        except Exception as e:  # noqa: BLE001 - dead/unreachable worker
+            self._client = None
+            self._retry_or_fail(sd, retries, attempts + 1, e)
+            return
+        logger.warning(
+            "actor call %s exceeded %.0fs; worker alive, re-attaching",
+            sd.get("name"), config.actor_call_deadline_s)
+        # no attempt consumed: the call is running, we merely re-attach
+        # (the worker piggybacks the duplicate push on the live execution)
+        self.q.put(("dispatch", sd, retries, attempts))
+
+    def _retry_or_fail(self, sd: Dict[str, Any], retries: int, attempts: int,
+                       e: BaseException) -> None:
+        if attempts > max(retries, 0):
+            self._fail(
+                sd,
+                f"actor call failed after {attempts} attempts: {e}",
+                "ActorDiedError" if isinstance(e, RpcError)
+                else "ActorUnavailableError")
+            return
+        time.sleep(min(0.1 * attempts, 0.5))
+        self.q.put(("dispatch", sd, retries, attempts))
+
+    def _fail(self, sd: Dict[str, Any], message: str, error_type: str) -> None:
+        self.rt._store_error_objects(sd, message, error_type)
+        self._finish(sd)
+
+    def _finish(self, sd: Dict[str, Any]) -> None:
+        """Release the in-flight pin exactly once — the unpin rides the SAME
+        FIFO refop channel as the pin, so it can never overtake it — then
+        unblock get()'s channel wait for these returns."""
+        rt = self.rt
+        holder = sd.get("task_holder")
+        if holder:
+            rt._queue_refop("unpin", {
+                "holder": holder,
+                "object_ids": (sd.get("deps") or []) + (sd.get("returns") or []),
+            })
+        rt._actor_returns_done(sd)
 
 
 def connect_driver(address: str, namespace: Optional[str] = None,

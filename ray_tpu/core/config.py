@@ -195,6 +195,37 @@ _DEFINITIONS = [
     ("min_memory_free_bytes", -1, int,
      "Absolute free-memory floor that also triggers the OOM killer when "
      "crossed (-1 = derive from memory_usage_threshold only)."),
+    # --- pipelined control plane ---
+    ("pipeline_enabled", True, bool,
+     "Pipelined control plane: batched task submission, windowed actor-call "
+     "dispatch, pushed completions and inline small results. Escape hatch: "
+     "env RTPU_PIPELINE=0 restores the lockstep request/response paths."),
+    ("inline_max_bytes", 8192, int,
+     "Task/actor-call results whose serialized payload is at most this many "
+     "bytes ride inline in the completion message (actor replies and pushed "
+     "seal events), skipping the arena write and/or the separate read RPC. "
+     "Env override: RTPU_INLINE_MAX_BYTES."),
+    ("submit_batch_max", 64, int,
+     "Driver-side task submissions coalesce into one submit_task_batch RPC; "
+     "a batch flushes when it reaches this many specs."),
+    ("submit_batch_window_ms", 1.0, float,
+     "Coalescing window before a partial submission batch flushes."),
+    ("submit_batch_max_bytes", 4 * 1024 * 1024, int,
+     "A submission batch also flushes once its argument payloads exceed "
+     "this many bytes (bounds per-frame memory)."),
+    ("actor_call_window", 32, int,
+     "Max in-flight pushed actor calls per actor per caller (the pipelining "
+     "window); the dispatcher blocks when the window is full."),
+    ("actor_call_deadline_s", 120.0, float,
+     "Per-attempt deadline for a pushed actor call. On expiry the caller "
+     "probes worker liveness: an alive worker means the call is merely "
+     "long-running and the caller re-attaches (the worker dedupes by "
+     "task_id), so long calls survive; a dead/unreachable worker routes "
+     "through the actor retry path instead of wedging the dispatcher."),
+    ("actor_reorder_wait_s", 2.0, float,
+     "Worker-side wait for a missing predecessor seq before executing a "
+     "later actor call anyway (keeps per-actor in-order execution across "
+     "retry-induced reordering without wedging on a lost call)."),
     # --- rpc ---
     ("rpc_connect_timeout_s", 10.0, float, "Socket connect timeout."),
     ("rpc_call_timeout_s", 60.0, float, "Default RPC deadline."),
@@ -235,3 +266,24 @@ _DEFINITIONS = [
 
 
 config = Config()
+
+
+def pipeline_enabled() -> bool:
+    """Pipelined control plane on/off. The RTPU_PIPELINE env var is the
+    operator escape hatch (tools/ray_perf.py --no-pipeline sets it) and wins
+    over the config entry so one process tree can be flipped wholesale."""
+    raw = os.environ.get("RTPU_PIPELINE")
+    if raw is not None:
+        return raw.strip().lower() not in ("0", "false", "no", "off")
+    return config.pipeline_enabled
+
+
+def inline_max_bytes() -> int:
+    """Inline-result threshold; RTPU_INLINE_MAX_BYTES env override wins."""
+    raw = os.environ.get("RTPU_INLINE_MAX_BYTES")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return config.inline_max_bytes

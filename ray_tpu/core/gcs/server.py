@@ -860,12 +860,26 @@ class GcsServer:
     async def rpc_register_object(
         self, object_id: str, size: int, node_id: str, owner: str = "",
         contained: Optional[List[str]] = None,
+        payload: Optional[bytes] = None,
     ) -> bool:
+        targets = await self._register_object_inner(
+            object_id, size, node_id, owner, contained, payload)
+        for holder, event in targets:
+            await self.rpc.publish(f"sealed:{holder}", {"events": [event]})
+        return True
+
+    async def _register_object_inner(
+        self, object_id: str, size: int, node_id: str, owner: str = "",
+        contained: Optional[List[str]] = None,
+        payload: Optional[bytes] = None,
+    ) -> List[Tuple[str, Dict[str, Any]]]:
+        """Register one location; returns the (holder, sealed-event) pairs to
+        push (the batch path coalesces them into one frame per holder)."""
         if object_id in self._freed_tombstones:
             # freed while this registration was in flight (direct path is
             # RETRY_SAFE, so a transparent retry can land after a
             # free_object_everywhere): stay dead, never resurrect
-            return True
+            return []
         rec = self.objects.setdefault(
             object_id, {"size": size, "locations": set(), "owner": owner}
         )
@@ -880,7 +894,25 @@ class GcsServer:
             self.object_contains[object_id] = list(contained)
             await self.rpc_add_object_refs(contained, f"obj:{object_id}")
         await self.rpc.publish(f"objects:{object_id}", {"size": size, "node_id": node_id})
-        return True
+        # push completions: every client-process holder (the submitter was
+        # registered on task returns at pin time) learns of the seal without
+        # polling; payloads at most the inline threshold ride in-band so the
+        # holder's get() needs neither an ensure RPC nor an arena read
+        # (reference: pushed object-location updates + inline small returns)
+        holders = [h for h in self.object_holders.get(object_id, ())
+                   if h.startswith("w:")]
+        if not holders:
+            return []
+        if payload is not None and self.rpc.chaos_drop_inline():
+            logger.warning("rpc chaos: stripping inline payload of %s",
+                           object_id[:16])
+            payload = None  # completion still arrives; receiver falls
+            # back to the ensure+read path
+        event = {"object_id": object_id, "size": size, "node_id": node_id,
+                 "is_error": owner.endswith(":error")}
+        if payload is not None:
+            event["payload"] = payload
+        return [(h, event) for h in holders]
 
     async def rpc_remove_object_location(self, object_id: str, node_id: str) -> bool:
         rec = self.objects.get(object_id)
@@ -927,11 +959,16 @@ class GcsServer:
         """Batched object registration: one RPC covers every object an agent
         sealed in the last coalescing tick (cuts a GCS round trip off every
         task-return seal; reference: flushed location updates in the
-        ownership protocol)."""
+        ownership protocol). Sealed-event pushes coalesce into ONE frame per
+        holder per batch — one receiver wakeup instead of one per object."""
+        per_holder: Dict[str, List[Dict[str, Any]]] = {}
         for i, r in enumerate(regs):
-            await self.rpc_register_object(**r)  # tombstone-checked inside
+            for holder, event in await self._register_object_inner(**r):
+                per_holder.setdefault(holder, []).append(event)
             if i % 100 == 99:
                 await asyncio.sleep(0)  # big batch: let heartbeats interleave
+        for holder, events in per_holder.items():
+            await self.rpc.publish(f"sealed:{holder}", {"events": events})
         return True
 
     async def rpc_pin_tasks(self, pins: List[Dict[str, Any]]) -> bool:
@@ -1060,6 +1097,13 @@ class GcsServer:
         if spec is not None:
             for object_id in returns:
                 self.lineage[object_id] = spec
+        return True
+
+    async def rpc_unpin_tasks(self, unpins: List[Dict[str, Any]]) -> bool:
+        """Batched task-pin release (one RPC per client coalescing tick —
+        the pipelined actor path's counterpart to rpc_pin_tasks)."""
+        for u in unpins:
+            await self.rpc_remove_object_refs(u["object_ids"], u["holder"])
         return True
 
     async def rpc_holder_heartbeat(self, holder: str) -> bool:

@@ -156,6 +156,12 @@ class NodeAgent:
         self._reg_queue: List[Dict[str, Any]] = []
         self._reg_event = asyncio.Event()
         self._reg_flusher: Optional[asyncio.Task] = None
+        # task-pin releases coalesce the same way (one unpin_tasks RPC per
+        # tick instead of one remove_object_refs round trip per finished
+        # task — the last per-task GCS RPC on the agent's hot path)
+        self._unpin_queue: List[Dict[str, Any]] = []
+        self._unpin_event = asyncio.Event()
+        self._unpin_flusher: Optional[asyncio.Task] = None
         self._peer_clients: Dict[str, RpcClient] = {}
         self._peer_addr_cache: Dict[str, str] = {}
         self._hb_task: Optional[asyncio.Task] = None
@@ -224,6 +230,7 @@ class NodeAgent:
             self._memory_task = asyncio.ensure_future(self._memory_monitor_loop())
         self._pin_flusher = asyncio.ensure_future(self._pin_flush_loop())
         self._reg_flusher = asyncio.ensure_future(self._reg_flush_loop())
+        self._unpin_flusher = asyncio.ensure_future(self._unpin_flush_loop())
         self._watchdog_task = spawn(loop_lag_watchdog("agent"))
         if self.is_head and config.dashboard_port >= 0:
             from ray_tpu.dashboard.head import DashboardHead
@@ -251,7 +258,7 @@ class NodeAgent:
         if self.dashboard is not None:
             await self.dashboard.stop()
         for t in (self._hb_task, self._supervise_task, self._memory_task,
-                  self._pin_flusher, self._reg_flusher,
+                  self._pin_flusher, self._reg_flusher, self._unpin_flusher,
                   self._log_monitor_task,
                   getattr(self, "_watchdog_task", None)):
             if t:
@@ -835,7 +842,8 @@ class NodeAgent:
 
     async def rpc_seal_object(self, object_id: str, size: int, owner: str = "",
                               is_error: bool = False,
-                              contained: Optional[List[str]] = None) -> bool:
+                              contained: Optional[List[str]] = None,
+                              payload: Optional[bytes] = None) -> bool:
         oid = ObjectID.from_hex(object_id)
         self.store.seal(oid)
         if is_error:
@@ -844,11 +852,17 @@ class NodeAgent:
         # while the previous flush is in flight) but the ack WAITS for the
         # flush: "sealed" always implies "GCS-registered" (state API and
         # remote waiters observe the object the moment the seal ack lands)
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._reg_queue.append(({
+        reg = {
             "object_id": object_id, "size": size, "node_id": self.hex,
             "owner": owner, "contained": contained or None,
-        }, fut))
+        }
+        from ray_tpu.core.config import inline_max_bytes
+        if payload is not None and len(payload) <= inline_max_bytes():
+            # small result: the payload rides the registration so the GCS can
+            # push it in-band to the submitter's sealed-event channel
+            reg["payload"] = payload
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._reg_queue.append((reg, fut))
         self._reg_event.set()
         await fut
         return True
@@ -874,6 +888,20 @@ class NodeAgent:
                     if not fut.done():
                         fut.set_exception(e)
                         fut.exception()  # sealer may have gone: mark seen
+                await asyncio.sleep(0.2)
+
+    async def _unpin_flush_loop(self) -> None:
+        while True:
+            await self._unpin_event.wait()
+            self._unpin_event.clear()
+            batch, self._unpin_queue = self._unpin_queue, []
+            if not batch:
+                continue
+            try:
+                await self.gcs.call("unpin_tasks", unpins=batch)
+            except Exception:  # noqa: BLE001 - advisory; node-scoped pins are
+                # reaped with this node if they leak
+                logger.exception("unpin flush failed")
                 await asyncio.sleep(0.2)
 
     async def _pin_flush_loop(self) -> None:
@@ -935,8 +963,11 @@ class NodeAgent:
             await asyncio.get_event_loop().run_in_executor(None, _write_segment)
         else:
             _write_segment()
+        from ray_tpu.core.config import inline_max_bytes
+        small = bytes(payload) if len(payload) <= inline_max_bytes() else None
         await self.rpc_seal_object(object_id, len(payload), owner=owner,
-                                   is_error=is_error, contained=contained)
+                                   is_error=is_error, contained=contained,
+                                   payload=small)
         return {"ok": True, "existing": None}
 
     async def rpc_abort_object(self, object_id: str) -> bool:
@@ -1426,9 +1457,25 @@ class NodeAgent:
         spec is retained as lineage for reconstruction. Pinning completes
         before this RPC returns, which closes the submit-then-drop race:
         the caller's arg refs are still live during this call."""
+        fut = self._accept_task(spec)
+        if fut is None:
+            return {"accepted": True}  # duplicate submit (retried RPC): dedupe
+        try:
+            # the ack still waits for the pin (it closes the submit-then-drop
+            # race) but the pin rides a BATCHED GCS RPC shared with every
+            # other submit in the same tick
+            await fut
+        except Exception:  # noqa: BLE001 - pinning is best-effort bookkeeping
+            logger.exception("ref pinning failed")
+        spawn(self._submit_with_retries(spec))
+        return {"accepted": True}
+
+    def _accept_task(self, spec: Dict[str, Any]) -> Optional[asyncio.Future]:
+        """Dedupe + queue the GCS ref pin for one submitted spec. Returns the
+        pin future, or None for a duplicate (already accepted) task."""
         tid = spec.get("task_id", "")
         if tid in self._accepted_tasks:
-            return {"accepted": True}  # duplicate submit (retried RPC): dedupe
+            return None
         self._accepted_tasks[tid] = time.monotonic()
         while len(self._accepted_tasks) > 20000:
             self._accepted_tasks.popitem(last=False)
@@ -1446,15 +1493,25 @@ class NodeAgent:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pin_queue.append((pin, fut))
         self._pin_event.set()
-        try:
-            # the ack still waits for the pin (it closes the submit-then-drop
-            # race) but the pin rides a BATCHED GCS RPC shared with every
-            # other submit in the same tick
-            await fut
-        except Exception:  # noqa: BLE001 - pinning is best-effort bookkeeping
-            logger.exception("ref pinning failed")
-        spawn(self._submit_with_retries(spec))
-        return {"accepted": True}
+        return fut
+
+    async def rpc_submit_task_batch(self, specs: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Coalesced driver-side submission: one RPC accepts a whole batch of
+        task specs (the driver flushes its buffer by size or a ~1 ms window).
+        Per-task dedupe makes the batch idempotent, so the method is
+        retry-safe; the ack waits for every batch member's ref pin exactly
+        like the single-spec path."""
+        entries = [(spec, self._accept_task(spec)) for spec in specs]
+        pins = [f for _, f in entries if f is not None]
+        if pins:
+            results = await asyncio.gather(*pins, return_exceptions=True)
+            for r in results:
+                if isinstance(r, Exception):
+                    logger.error("ref pinning failed in batch: %s", r)
+        for spec, fut in entries:
+            if fut is not None:
+                spawn(self._submit_with_retries(spec))
+        return {"accepted": sum(1 for _, f in entries if f is not None)}
 
     def _task_holder(self, spec: Dict[str, Any]) -> str:
         # node-scoped so the GCS can drop this pin if the whole node dies
@@ -1478,16 +1535,14 @@ class NodeAgent:
             self._unreachable_since.pop(spec.get("task_id", ""), None)
             self._infeasible_since.pop(spec.get("task_id", ""), None)
             # release the task pin: returns stay alive through the
-            # submitter's holder; deps fall back to their own holders
+            # submitter's holder; deps fall back to their own holders.
+            # Rides the batched unpin flush (one GCS RPC per tick).
             pinned = (spec.get("deps") or []) + (spec.get("returns") or [])
             if pinned:
-                try:
-                    await self.gcs.call(
-                        "remove_object_refs", object_ids=pinned,
-                        holder=self._task_holder(spec),
-                    )
-                except Exception:  # noqa: BLE001
-                    pass
+                self._unpin_queue.append({
+                    "holder": self._task_holder(spec), "object_ids": pinned,
+                })
+                self._unpin_event.set()
 
     def _can_grant_locally(self, spec: Dict[str, Any]) -> bool:
         """Local-first fast path (reference two-level design:
@@ -1814,23 +1869,6 @@ class NodeAgent:
         try:
             result = await w.client.call("run_task", spec=spec, timeout=None)
             self._set_task_state(tid, "executed")
-            # small returns ride inline in the reply: write+seal them here
-            # (one fewer worker->agent round trip per task)
-            inline = (result or {}).pop("inline_returns", None) or []
-            try:
-                for item in inline:
-                    await self._put_local(**item)
-            except ObjectStoreFullError as e:
-                # the task ran but its returns don't fit RIGHT NOW: requeue
-                # (at-least-once; already-sealed returns dedupe on re-store)
-                # instead of surfacing an internal error
-                return {"ok": False, "retryable": True, "reason": "busy",
-                        "error": f"store full for returns: {e}"}
-            if (result or {}).get("state") == "retry_store_full":
-                # worker-side big-return store failed the same way: requeue
-                return {"ok": False, "retryable": True, "reason": "busy",
-                        "error": "store full for returns (worker)"}
-            return {"ok": True, **(result or {})}
         except (RpcConnectionError, RpcError) as e:
             if isinstance(e, RpcError):
                 # handler-level failure: error object was stored by the worker
@@ -1843,6 +1881,10 @@ class NodeAgent:
                         "oom": True}
             return {"ok": False, "retryable": True, "error": f"worker connection lost: {e}"}
         finally:
+            # release the worker + resource slot the moment execution ends:
+            # sealing the returns below is AGENT-side work and must not
+            # extend slot occupancy (it awaits a batched GCS registration —
+            # ~tens of ms that used to serialize into every slot's turnover)
             w.running_task = None
             if not w.blocked:
                 self._release_token(token)
@@ -1853,6 +1895,23 @@ class NodeAgent:
                 self._release_tpu_worker(w)
             else:
                 self._release_worker(w)
+        # small returns ride inline in the reply: write+seal them here
+        # (one fewer worker->agent round trip per task)
+        inline = (result or {}).pop("inline_returns", None) or []
+        try:
+            for item in inline:
+                await self._put_local(**item)
+        except ObjectStoreFullError as e:
+            # the task ran but its returns don't fit RIGHT NOW: requeue
+            # (at-least-once; already-sealed returns dedupe on re-store)
+            # instead of surfacing an internal error
+            return {"ok": False, "retryable": True, "reason": "busy",
+                    "error": f"store full for returns: {e}"}
+        if (result or {}).get("state") == "retry_store_full":
+            # worker-side big-return store failed the same way: requeue
+            return {"ok": False, "retryable": True, "reason": "busy",
+                    "error": "store full for returns (worker)"}
+        return {"ok": True, **(result or {})}
 
     def _try_acquire(self, resources: Dict[str, float], dry_run: bool = False) -> bool:
         for k, v in resources.items():
@@ -1985,12 +2044,14 @@ class NodeAgent:
             except Exception:  # noqa: BLE001
                 logger.exception("failed to report stream error")
             return
+        from ray_tpu.core.config import inline_max_bytes
+        small = bytes(payload) if len(payload) <= inline_max_bytes() else None
         for object_id in spec.get("returns", []):
             try:
                 await self._write_error_object(object_id, payload)
                 await self.gcs.call(
                     "register_object", object_id=object_id, size=len(payload),
-                    node_id=self.hex, owner=":error",
+                    node_id=self.hex, owner=":error", payload=small,
                 )
             except FileExistsError:
                 pass  # a retry already stored a result

@@ -57,6 +57,18 @@ class WorkerProcess:
         self._actor_max_concurrency = 1
         self._actor_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # pipelined actor-call state (reference: ActorSchedulingQueue seq_no
+        # ordering + completed-task dedup):
+        # caller id -> {"next": expected seq, "ev": event set on each advance}
+        self._actor_seq: Dict[str, Dict[str, Any]] = {}
+        # task_id -> reply: completed-call cache so a re-pushed call (caller
+        # deadline expiry / connection retry) replays instead of re-executing
+        from collections import OrderedDict
+
+        self._actor_done: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        # task_id -> future: a duplicate push of a STILL-RUNNING call
+        # piggybacks on the original execution instead of starting a second
+        self._actor_inflight: Dict[str, asyncio.Future] = {}
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
@@ -152,14 +164,21 @@ class WorkerProcess:
 
     def _store_value(self, object_id: str, value: Any, is_error: bool = False,
                      collector: Optional[List[Dict[str, Any]]] = None,
-                     xlang: bool = False) -> None:
+                     xlang: bool = False,
+                     inline_limit: Optional[int] = None) -> None:
         if xlang:
             payload, refs = serialization.xlang_pack(value), []
         else:
             payload, refs = serialization.pack(value)
         oid = ObjectID.from_hex(object_id)
-        if (collector is not None
-                and len(payload) <= config.max_direct_call_object_size):
+        # inline_limit set = actor-call completion path: the payload rides the
+        # reply to the CALLER and never touches this node's arena, so nested
+        # ObjectRefs must fall through to the agent path (their contained-ref
+        # pins only exist for GCS-registered containers)
+        collect_ok = (len(payload) <= config.max_direct_call_object_size
+                      if inline_limit is None
+                      else (len(payload) <= inline_limit and not refs))
+        if collector is not None and collect_ok:
             # small return rides INLINE in the run_task reply: the agent
             # writes+seals it locally, removing a full worker->agent round
             # trip per task (reference: max_direct_call_object_size inlining)
@@ -218,13 +237,14 @@ class WorkerProcess:
         ).result()
 
     def _store_returns(self, spec: Dict[str, Any], result: Any,
-                       collector: Optional[List[Dict[str, Any]]] = None) -> None:
+                       collector: Optional[List[Dict[str, Any]]] = None,
+                       inline_limit: Optional[int] = None) -> None:
         returns: List[str] = spec["returns"]
         xlang = bool(spec.get("xlang"))
         if len(returns) == 1:
             try:
                 self._store_value(returns[0], result, collector=collector,
-                                  xlang=xlang)
+                                  xlang=xlang, inline_limit=inline_limit)
             except FileExistsError:
                 pass  # duplicate execution (at-least-once): result already stored
             return
@@ -236,18 +256,21 @@ class WorkerProcess:
             )
             for r in returns:
                 try:
-                    self._store_value(r, err, is_error=True, collector=collector)
+                    self._store_value(r, err, is_error=True, collector=collector,
+                                      inline_limit=inline_limit)
                 except FileExistsError:
                     pass
             return
         for r, v in zip(returns, result):
             try:
-                self._store_value(r, v, collector=collector, xlang=xlang)
+                self._store_value(r, v, collector=collector, xlang=xlang,
+                                  inline_limit=inline_limit)
             except FileExistsError:
                 pass  # duplicate execution (at-least-once): already stored
 
     def _store_error_returns(self, spec: Dict[str, Any], e: BaseException,
-                             collector: Optional[List[Dict[str, Any]]] = None) -> None:
+                             collector: Optional[List[Dict[str, Any]]] = None,
+                             inline_limit: Optional[int] = None) -> None:
         err: Any = exc.TaskError.from_exception(
             e, spec.get("name", "?"), pid=os.getpid(), node_id=self.node_hex
         )
@@ -259,7 +282,7 @@ class WorkerProcess:
         for r in spec["returns"]:
             try:
                 self._store_value(r, err, is_error=True, collector=collector,
-                                  xlang=xlang)
+                                  xlang=xlang, inline_limit=inline_limit)
             except FileExistsError:
                 pass
         if spec.get("streaming") and spec.get("returns"):
@@ -461,7 +484,9 @@ class WorkerProcess:
             w.set_task_context(None)
             self._flush_profile_spans()
 
-    async def rpc_run_actor_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+    async def rpc_run_actor_task(self, spec: Dict[str, Any],
+                                 seq: Optional[int] = None,
+                                 caller: str = "") -> Dict[str, Any]:
         if self.actor_instance is None:
             raise exc.ActorDiedError(self.actor_id or "", "actor not constructed")
         if spec.get("actor_id") != self.actor_id:
@@ -469,11 +494,94 @@ class WorkerProcess:
             raise ConnectionError(
                 f"worker hosts actor {str(self.actor_id)[:8]}, not {spec.get('actor_id', '')[:8]}"
             )
-        pool = self._actor_pool
-        if pool is not None:
-            return await self._loop.run_in_executor(pool, self._execute_actor_task, spec)
-        # max_concurrency == 1: dedicated ordered executor (single thread)
-        return await self._loop.run_in_executor(self._ordered_executor(), self._execute_actor_task, spec)
+        tid = spec.get("task_id", "")
+        done = self._actor_done.get(tid)
+        if done is not None:
+            return done  # re-pushed completed call (caller retry): replay
+        running = self._actor_inflight.get(tid)
+        if running is not None:
+            # duplicate push of a STILL-RUNNING call (caller deadline expired
+            # and re-attached): wait on the original execution — never run a
+            # non-idempotent method twice
+            return await asyncio.shield(running)
+        fut: asyncio.Future = self._loop.create_future()
+        self._actor_inflight[tid] = fut
+        try:
+            if seq is not None and self._actor_pool is None:
+                # windowed pipelining: frames normally arrive in seq order on
+                # the persistent connection, but retries/reconnects reorder —
+                # gate EXECUTOR SUBMISSION by seq; the single-thread executor
+                # then runs jobs in submission order, so the turn advances at
+                # submission time and consecutive calls pipeline through the
+                # executor without a loop round trip between them
+                await self._await_turn(caller, seq)
+                try:
+                    exec_fut = self._loop.run_in_executor(
+                        self._ordered_executor(), self._execute_actor_task, spec)
+                finally:
+                    self._advance_turn(caller, seq)
+                reply = await exec_fut
+            else:
+                pool = self._actor_pool or self._ordered_executor()
+                reply = await self._loop.run_in_executor(
+                    pool, self._execute_actor_task, spec)
+            self._actor_done[tid] = reply
+            while len(self._actor_done) > 512:
+                self._actor_done.popitem(last=False)
+            if not fut.done():
+                fut.set_result(reply)
+            return reply
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()  # piggybackers may be gone: mark retrieved
+            raise
+        finally:
+            self._actor_inflight.pop(tid, None)
+
+    async def _await_turn(self, caller: str, seq: int) -> None:
+        """Block until `seq` is the next expected call from `caller`, or the
+        reorder window expires (a lost/abandoned predecessor must not wedge
+        the actor). First contact from a caller accepts its current seq
+        (actor restarts join a caller's sequence mid-stream)."""
+        st = self._actor_seq.get(caller)
+        if st is None:
+            st = self._actor_seq[caller] = {
+                "next": seq, "ev": asyncio.Event(),
+            }
+            while len(self._actor_seq) > 256:  # bounded per-caller state
+                oldest = next(iter(self._actor_seq))
+                if oldest == caller:
+                    break
+                del self._actor_seq[oldest]
+        deadline = self._loop.time() + config.actor_reorder_wait_s
+        last_next = st["next"]
+        while seq > st["next"]:
+            if st["next"] != last_next:
+                # predecessors ARE arriving: measure the stall, not the total
+                # queue wait — a deep window must not trip the skip-forward
+                last_next = st["next"]
+                deadline = self._loop.time() + config.actor_reorder_wait_s
+            remaining = deadline - self._loop.time()
+            if remaining <= 0:
+                # predecessor lost (failed call whose error objects the
+                # caller already stored): skip forward, don't wedge
+                st["next"] = seq
+                break
+            ev = st["ev"]
+            try:
+                await asyncio.wait_for(asyncio.shield(ev.wait()), remaining)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
+
+    def _advance_turn(self, caller: str, seq: int) -> None:
+        st = self._actor_seq.get(caller)
+        if st is None:
+            return
+        if seq + 1 > st["next"]:
+            st["next"] = seq + 1
+        ev, st["ev"] = st["ev"], asyncio.Event()
+        ev.set()  # wake every parked successor; each re-checks its turn
 
     _ordered: Optional[concurrent.futures.ThreadPoolExecutor] = None
 
@@ -506,13 +614,28 @@ class WorkerProcess:
                 result = asyncio.run_coroutine_threadsafe(result, self._loop).result()
             if spec.get("streaming"):
                 return self._drive_streaming(spec, result)
-            self._store_returns(spec, result)
-            return {"state": "ok"}
+            # pipelined callers ask for small results IN the completion reply
+            # (spec["inline_max"]): those payloads skip the arena write and
+            # the caller's read RPC entirely
+            inline_max = int(spec.get("inline_max") or 0)
+            inline: Optional[List[Dict[str, Any]]] = [] if inline_max else None
+            self._store_returns(spec, result, collector=inline,
+                                inline_limit=inline_max or None)
+            reply = {"state": "ok"}
+            if inline:
+                reply["inline_returns"] = inline
+            return reply
         except BaseException as e:  # noqa: BLE001
-            self._store_error_returns(spec, e)
+            inline_max = int(spec.get("inline_max") or 0)
+            inline = [] if inline_max else None
+            self._store_error_returns(spec, e, collector=inline,
+                                      inline_limit=inline_max or None)
             if isinstance(e, (SystemExit, KeyboardInterrupt)):
                 os._exit(1)
-            return {"state": "error"}
+            reply = {"state": "error"}
+            if inline:
+                reply["inline_returns"] = inline
+            return reply
         finally:
             w.set_task_context(None)
             self._flush_profile_spans()

@@ -62,6 +62,13 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
 
 
 class _Chaos:
+    """Seeded fault injector. Beyond request/response drops it also covers
+    the pipelined control-plane frames: pushed completion events
+    (``should_drop_push``, consulted by RpcServer.publish) and inline result
+    payloads (``should_drop_inline``, consulted by the GCS before attaching
+    a payload to a sealed event) — so retry/fallback coverage tracks the
+    pipelined protocol instead of silently shrinking to the lockstep one."""
+
     def __init__(self, enabled: bool = True) -> None:
         prob = config.rpc_chaos_failure_prob if enabled else 0.0
         self.prob = prob
@@ -69,6 +76,11 @@ class _Chaos:
 
     def should_drop(self) -> bool:
         return self.rng is not None and self.rng.random() < self.prob
+
+    # distinct names so call sites read as what they inject; same process
+    # (one seeded stream) so runs stay reproducible
+    should_drop_push = should_drop
+    should_drop_inline = should_drop
 
 
 # Methods a client may transparently re-send after a (possibly chaos-induced)
@@ -134,7 +146,8 @@ RETRY_SAFE_METHODS = frozenset({
     "push_object",
     # publish_worker_logs: seq-deduplicated at the GCS (exactly-once)
     "publish_worker_logs",
-    "add_object_refs", "remove_object_refs", "pin_task", "drop_holder",
+    "add_object_refs", "remove_object_refs", "pin_task", "unpin_tasks",
+    "drop_holder",
     "holder_heartbeat", "object_ref_counts", "put_lineage", "get_lineage",
     "get_actor", "get_actor_spec", "get_named_actor", "list_named_actors",
     "list_actors", "actor_started", "placement_group_info",
@@ -143,6 +156,9 @@ RETRY_SAFE_METHODS = frozenset({
     "stream_put", "stream_end", "stream_next", "stream_wait", "stream_close",
     "stream_state",
     "submit_task", "worker_ready", "worker_blocked", "worker_unblocked",
+    # submit_task_batch: per-task deduplicated at the agent (same as
+    # submit_task), so re-sending a whole batch re-accepts nothing
+    "submit_task_batch",
     "__subscribe__",
 })
 
@@ -275,7 +291,16 @@ class RpcServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    def chaos_drop_inline(self) -> bool:
+        """Fault injection for inline payloads riding pushed completions:
+        True = the caller should strip the payload (the completion itself
+        still arrives), exercising the receiver's fallback-read path."""
+        return self._chaos is not None and self._chaos.should_drop_inline()
+
     async def publish(self, channel: str, data: Any) -> None:
+        if self._chaos is not None and self._chaos.should_drop_push():
+            logger.warning("rpc chaos: dropping push on %s", channel)
+            return
         dead = []
         frame = _pack({"c": channel, "d": data})
         for w in list(self._subscribers.get(channel, set())):

@@ -200,6 +200,9 @@ def test_lost_object_is_reconstructed(cluster):
 
 
 def test_lost_actor_return_raises_object_lost(cluster):
+    """A store-resident actor return (above the inline threshold, so it
+    lives only in the producer node's arena) dies with its node: no
+    lineage for actor tasks, so get() must raise, not hang."""
     node = cluster.add_node(num_cpus=1)
     cluster.wait_for_nodes(2)
     target = _node_id_of(cluster, node)
@@ -208,7 +211,7 @@ def test_lost_actor_return_raises_object_lost(cluster):
     @ray_tpu.remote
     class P:
         def make(self):
-            return "actor-data"
+            return "x" * (64 * 1024)  # > inline threshold: arena-resident
 
     strat = NodeAffinitySchedulingStrategy(node_id=target, soft=False)
     p = P.options(scheduling_strategy=strat).remote()
@@ -224,6 +227,31 @@ def test_lost_actor_return_raises_object_lost(cluster):
 
     with pytest.raises((exceptions.ObjectLostError, exceptions.GetTimeoutError)):
         ray_tpu.get(ref, timeout=20)
+
+
+def test_small_actor_return_survives_producer_node_loss(cluster):
+    """Pipelined protocol upgrade: a SMALL actor return rides inline in the
+    completion to the caller, so losing the producer node after completion
+    does not lose the value (the reference inlines small returns to the
+    owner the same way)."""
+    node = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    target = _node_id_of(cluster, node)
+    assert target
+
+    @ray_tpu.remote
+    class P:
+        def make(self):
+            return "actor-data"
+
+    strat = NodeAffinitySchedulingStrategy(node_id=target, soft=False)
+    p = P.options(scheduling_strategy=strat).remote()
+    ref = p.make.remote()
+    assert ray_tpu.get(ref, timeout=60) == "actor-data"  # completion absorbed
+
+    cluster.remove_node(node)
+    time.sleep(0.5)
+    assert ray_tpu.get(ref, timeout=20) == "actor-data"
 
 
 def test_reconstruction_with_lost_dependency_chain(cluster):

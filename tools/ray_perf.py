@@ -2,10 +2,15 @@
 :120-241 — tasks/sec, actor calls/sec, put/get throughput).
 
 Usage:
-    python tools/ray_perf.py            # in-process local runtime
-    python tools/ray_perf.py --cluster  # real multi-process cluster (1 node)
+    python tools/ray_perf.py                 # in-process local runtime
+    python tools/ray_perf.py --cluster       # real multi-process cluster (1 node)
+    python tools/ray_perf.py --cluster --no-pipeline   # lockstep control plane
+    python tools/ray_perf.py --cluster --smoke         # fast CI smoke preset
+    python tools/ray_perf.py --cluster --out results.json
 
-Prints one JSON line per metric.
+Prints one JSON line per metric. --no-pipeline sets RTPU_PIPELINE=0 before
+the cluster starts (inherited by every agent/worker), so regressions are
+attributable to the pipelined control plane vs the lockstep one.
 """
 
 import argparse
@@ -18,13 +23,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def bench(name, fn, n, unit="ops/s"):
+def bench(name, fn, n, results, unit="ops/s"):
     t0 = time.perf_counter()
     fn(n)
     dt = time.perf_counter() - t0
     rate = n / dt
     print(json.dumps({"metric": name, "value": round(rate, 1), "unit": unit,
                       "n": n, "seconds": round(dt, 3)}))
+    results[name] = round(rate, 1)
     return rate
 
 
@@ -34,7 +40,19 @@ def main() -> None:
                         help="run against a real multi-process cluster")
     parser.add_argument("--scale", type=float, default=1.0,
                         help="multiply iteration counts")
+    parser.add_argument("--no-pipeline", action="store_true",
+                        help="lockstep control plane (sets RTPU_PIPELINE=0 "
+                             "for this process tree)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="fast CI smoke preset (implies --scale 0.05)")
+    parser.add_argument("--out", default=None,
+                        help="also append a JSON summary line to this file")
     args = parser.parse_args()
+
+    if args.no_pipeline:
+        os.environ["RTPU_PIPELINE"] = "0"
+    if args.smoke:
+        args.scale = min(args.scale, 0.05)
 
     import ray_tpu
 
@@ -77,14 +95,30 @@ def main() -> None:
         ray_tpu.get([a.nop.remote() for _ in range(n)], timeout=600)
 
     mode = "cluster" if args.cluster else "local"
-    bench(f"{mode}_tasks_per_sec", tasks_submit_get, int(500 * s))
-    bench(f"{mode}_puts_per_sec", puts, int(1000 * s))
-    bench(f"{mode}_batched_get_per_sec", batched_get, int(1000 * s))
-    bench(f"{mode}_actor_calls_per_sec", actor_calls, int(500 * s))
+    results = {}
+    bench(f"{mode}_tasks_per_sec", tasks_submit_get, int(500 * s), results)
+    bench(f"{mode}_puts_per_sec", puts, int(1000 * s), results)
+    bench(f"{mode}_batched_get_per_sec", batched_get, int(1000 * s), results)
+    bench(f"{mode}_actor_calls_per_sec", actor_calls, int(500 * s), results)
 
-    ray_tpu.shutdown()
-    if cluster is not None:
-        cluster.shutdown()
+    if args.out:
+        from ray_tpu.core.config import pipeline_enabled
+
+        with open(args.out, "a") as f:
+            f.write(json.dumps({
+                "mode": mode,
+                "pipeline": pipeline_enabled(),
+                "scale": s,
+                "results": results,
+            }) + "\n")
+
+    try:
+        ray_tpu.shutdown()
+    finally:
+        # the cluster must die even if runtime teardown raises — a leaked
+        # GCS/agent/worker set silently poisons every later benchmark run
+        if cluster is not None:
+            cluster.shutdown()
 
 
 if __name__ == "__main__":
