@@ -207,6 +207,45 @@ def profile_ops(config, state, batch: int, seq: int, repeats: int = 5):
     }
 
 
+def measure_object_transfer(size: int = 16 << 20) -> dict:
+    """Data-plane sample for the perf trajectory: node-to-node object pull
+    MB/s on a tiny same-host cluster (the control plane is tracked by
+    ray_perf; this keeps the artifact honest about the DATA plane too).
+    Runs in subprocess-spawned agents with JAX untouched; bounded seconds."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.rpc import SyncRpcClient
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    try:
+        node2 = cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes(2, timeout=60)
+        ray_tpu.init(address=cluster.gcs_address)
+        payload = np.zeros(size, dtype=np.uint8)
+        ref = ray_tpu.put(payload)
+        agent2 = SyncRpcClient(node2.address)
+        try:
+            t0 = time.perf_counter()
+            agent2.call("ensure_local", object_id=ref.id.hex(),
+                        timeout_s=120.0, timeout=130.0)
+            dt = time.perf_counter() - t0
+            stats = agent2.call("transfer_stats")
+        finally:
+            agent2.close()
+        return {
+            "pull_mbps": round(size / dt / 1e6, 1),
+            "bytes": size,
+            "raw_transfer": bool((stats.get("pulls", 0) or 0) >= 1),
+        }
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            cluster.shutdown()
+
+
 def main(large: bool = False) -> None:
     import jax
     import jax.numpy as jnp
@@ -318,6 +357,16 @@ def main(large: bool = False) -> None:
             result["per_op_profile"] = prof
         except Exception as e:  # noqa: BLE001 - the headline must still print
             result["per_op_profile"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    # data-plane sample (opt out: RAY_TPU_BENCH_TRANSFER=0) so the emitted
+    # artifact tracks object-transfer throughput alongside the train step
+    if os.environ.get("RAY_TPU_BENCH_TRANSFER", "1") != "0":
+        try:
+            result["object_transfer"] = measure_object_transfer()
+        except Exception as e:  # noqa: BLE001 - environment failure: skip,
+            # never sink the training headline
+            result["object_transfer"] = {
+                "skipped": True, "error": f"{type(e).__name__}: {e}"[:200]}
 
     print(json.dumps(result))
 

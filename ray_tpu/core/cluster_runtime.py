@@ -226,22 +226,63 @@ class ClusterRuntime(CoreRuntime):
 
     def _put_via_rpc(self, oid: ObjectID, payload,
                      contained: Optional[List[str]]) -> None:
+        """Stream a large put into the agent store. Raw plane: chunk
+        payloads ride raw frames (memoryview straight to the socket, no
+        per-chunk bytes() copy or msgpack encode) with a window of sends in
+        flight instead of one serial round trip per chunk; the agent's
+        cached-writer ingest seals + registers once every byte lands.
+        RTPU_RAW_TRANSFER=0 restores the serial in-band path."""
+        from ray_tpu.core.config import raw_transfer_enabled
+
         size = len(payload)
         view = memoryview(payload)
         chunk = config.fetch_chunk_bytes
-        sent = 0
-        while True:
-            n = min(chunk, size - sent)
-            last = sent + n >= size
-            self.agent.call(
-                "receive_chunk", object_id=oid.hex(), total_size=size,
-                offset=sent, data=bytes(view[sent:sent + n]),
-                contained=contained if last else None,
-                timeout=120.0,
+        if not raw_transfer_enabled():
+            sent = 0
+            while True:
+                n = min(chunk, size - sent)
+                last = sent + n >= size
+                self.agent.call(
+                    "receive_chunk", object_id=oid.hex(), total_size=size,
+                    offset=sent, data=bytes(view[sent:sent + n]),
+                    contained=contained if last else None,
+                    timeout=120.0,
+                )
+                sent += n
+                if last:
+                    return
+        from collections import deque
+
+        window = max(1, int(config.transfer_window_chunks))
+        inflight: "deque" = deque()
+
+        from ray_tpu.core.node.transfer import attempt_timeout
+
+        def send_async(off: int, attempt: int = 0):
+            n = min(chunk, size - off)
+            return self.agent.call_raw_send_async(
+                "receive_chunk_raw", view[off:off + n],
+                timeout=attempt_timeout(attempt),
+                object_id=oid.hex(), total_size=size, offset=off,
+                contained=contained,
             )
-            sent += n
-            if last:
-                return
+
+        offsets = list(range(0, size, chunk)) or [0]
+        retried: Dict[int, int] = {}
+        while offsets or inflight:
+            while offsets and len(inflight) < window:
+                off = offsets.pop(0)
+                inflight.append((off, send_async(off, retried.get(off, 0))))
+            off, fut = inflight.popleft()
+            try:
+                fut.result()
+            except TimeoutError:
+                # idempotent ingest (deduped by offset): re-send the chunk
+                # instead of failing the put on one dropped frame
+                retried[off] = retried.get(off, 0) + 1
+                if retried[off] > 5:
+                    raise
+                offsets.insert(0, off)
 
     def start_log_stream(self) -> None:
         """Subscribe to the cluster's worker-log pubsub channel and mirror
@@ -263,6 +304,10 @@ class ClusterRuntime(CoreRuntime):
             logger.warning("worker-log stream unavailable", exc_info=True)
 
     def _read_via_rpc(self, oid: ObjectID, size: int) -> bytes:
+        from ray_tpu.core.config import raw_transfer_enabled
+
+        if raw_transfer_enabled():
+            return self._read_via_raw(oid, size)
         data = bytearray()
         chunk = config.fetch_chunk_bytes
         while len(data) < size:
@@ -279,6 +324,52 @@ class ClusterRuntime(CoreRuntime):
                     raise FileNotFoundError(str(e)) from e
                 raise
         return bytes(data)
+
+    def _read_via_raw(self, oid: ObjectID, size: int) -> bytes:
+        """Client-mode chunked read over raw frames: payload bytes land
+        straight in the destination buffer (no msgpack decode, no per-chunk
+        bytes accumulation), with a window of requests in flight. Short
+        chunks (chaos truncation) re-request exactly the missing tail."""
+        from collections import deque
+
+        buf = bytearray(size)
+        mv = memoryview(buf)
+        chunk = config.fetch_chunk_bytes
+        window = max(1, int(config.transfer_window_chunks))
+        work = deque((off, min(chunk, size - off))
+                     for off in range(0, size, chunk))
+        requeues = 0
+        max_requeues = 8 * (len(work) + 1)
+        while work:
+            batch = []
+            while work and len(batch) < window:
+                off, n = work.popleft()
+                dest = mv[off:off + n]
+
+                def make_sink(d):
+                    return lambda meta, nbytes: d[:nbytes] if nbytes else None
+
+                batch.append((off, n, self.agent.call_raw_async(
+                    "read_chunk_raw", make_sink(dest), timeout=120.0,
+                    object_id=oid.hex(), offset=off, length=n)))
+            for off, n, fut in batch:
+                try:
+                    res = fut.result()
+                except RpcError as e:
+                    if e.remote_type == "KeyError":
+                        raise FileNotFoundError(str(e)) from e
+                    raise
+                except TimeoutError:
+                    res = {"nbytes": 0}
+                got = int(res.get("nbytes", 0))
+                if got < n:
+                    requeues += 1
+                    if requeues > max_requeues:
+                        raise TimeoutError(
+                            f"chunked read of {oid.hex()[:16]} kept losing "
+                            f"frames after {requeues} re-requests")
+                    work.append((off + got, n - got))
+        return bytes(buf)
 
     def _read_local(self, oid: ObjectID, size: int, is_error: bool,
                     offset: Optional[int] = None) -> Any:

@@ -115,6 +115,31 @@ _DEFINITIONS = [
      "Chunk size for node-to-node object transfer."),
     ("object_transfer_retries", 5, int,
      "Pull retries (exponential backoff) before an object fetch errors."),
+    # --- zero-copy pipelined transfer plane ---
+    ("raw_transfer_enabled", True, bool,
+     "Data plane for object bytes: raw binary frames (small msgpack header "
+     "+ payload written/received as memoryviews, socket<->arena with no "
+     "msgpack encode of the payload) with windowed pipelined chunk "
+     "requests, striped multi-source pulls and mid-object failover. "
+     "Escape hatch: env RTPU_RAW_TRANSFER=0 restores the serial in-band "
+     "msgpack chunk path."),
+    ("pull_stripe_enabled", True, bool,
+     "Striped pulls: spread chunk ranges of one object across every "
+     "GCS-known holder instead of draining a single source."),
+    ("transfer_window_chunks", 8, int,
+     "In-flight chunk requests per transfer source (the pull/push "
+     "pipelining window; 1 = lockstep await-per-chunk)."),
+    ("transfer_max_sources", 4, int,
+     "Max holders one striped pull spreads its chunk ranges across."),
+    ("transfer_inflight_max_bytes", 256 * 1024 * 1024, int,
+     "Global budget of in-flight transfer bytes per agent (backpressure: "
+     "chunk requests wait instead of over-committing arena/network)."),
+    ("transfer_chunk_timeout_s", 60.0, float,
+     "Per-chunk deadline on the raw transfer plane before the chunk is "
+     "re-requested (possibly from another source)."),
+    ("transfer_ingest_idle_s", 60.0, float,
+     "In-flight chunked ingests (cached writer keyed by object id) idle "
+     "longer than this are aborted and swept."),
     ("object_ref_grace_s", 2.0, float,
      "Grace window after an object's cluster-wide holder set empties before "
      "the GCS frees it everywhere (absorbs in-flight ref handoffs)."),
@@ -276,6 +301,17 @@ def pipeline_enabled() -> bool:
     if raw is not None:
         return raw.strip().lower() not in ("0", "false", "no", "off")
     return config.pipeline_enabled
+
+
+def raw_transfer_enabled() -> bool:
+    """Raw-frame data plane on/off. The RTPU_RAW_TRANSFER env var is the
+    operator escape hatch (tools/ray_perf.py --no-raw-transfer sets it) and
+    wins over the config entry so one process tree can be flipped wholesale
+    for A/B measurement against the msgpack in-band path."""
+    raw = os.environ.get("RTPU_RAW_TRANSFER")
+    if raw is not None:
+        return raw.strip().lower() not in ("0", "false", "no", "off")
+    return config.raw_transfer_enabled
 
 
 def inline_max_bytes() -> int:

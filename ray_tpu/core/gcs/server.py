@@ -311,6 +311,19 @@ class GcsServer:
     # -------------------------------------------------------------------- kv
     async def rpc_kv_put(self, key: str, value: bytes) -> bool:
         self.kv[key] = value
+        if key.startswith(("fn:", "runtimeenv:")) and self._storage is not None:
+            # durable-critical keys (function exports, runtime-env packages)
+            # are written ONCE per content hash and silently cached by the
+            # writer — losing one to a crash inside the periodic-snapshot
+            # window strands every later task on "function not found in GCS
+            # KV" with no path to re-export. Flush eagerly; these writes are
+            # rare (once per function/package, not per task).
+            try:
+                state = self._snapshot_state()
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._write_snapshot, state)
+            except Exception:  # noqa: BLE001 - persistence is best-effort
+                logger.exception("eager snapshot after kv_put failed")
         return True
 
     async def rpc_kv_get(self, key: str) -> Optional[bytes]:
@@ -457,6 +470,12 @@ class GcsServer:
         Pending groups feed the autoscaler's demand ledger and are retried by
         _pg_retry_loop as capacity arrives (reference: GcsPlacementGroup-
         Manager pending queue + SchedulePendingPlacementGroups)."""
+        if pg_id in self.pgs:
+            # duplicate create (re-sent after a dropped response): the first
+            # attempt won — re-placing could commit bundles on a DIFFERENT
+            # node set and leak the first reservation. Makes the method
+            # retry-safe.
+            return True
         placed = await self._try_place_pg(pg_id, bundles, strategy, name)
         if not placed:
             self.pgs[pg_id] = {
@@ -945,9 +964,16 @@ class GcsServer:
         rec = self.objects.get(object_id)
         if rec is None:
             return None
+        locations = sorted(rec["locations"])
+        if len(locations) > 1:
+            # rotate per lookup: concurrent pullers (and single-source
+            # pulls with striping off) spread across holders instead of
+            # all draining the lexicographically-first replica
+            k = rec["_rr"] = (rec.get("_rr", 0) + 1) % len(locations)
+            locations = locations[k:] + locations[:k]
         return {
             "size": rec["size"],
-            "locations": sorted(rec["locations"]),
+            "locations": locations,
             "owner": rec["owner"],
             # lost = every copy was on since-dead nodes: the value is gone and
             # only lineage reconstruction (owner resubmits the producing task)

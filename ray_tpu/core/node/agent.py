@@ -28,10 +28,11 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu.core.config import config
+from ray_tpu.core.config import config, raw_transfer_enabled
 from ray_tpu.core.ids import NodeID, ObjectID
-from ray_tpu.core.rpc import (RpcClient, RpcConnectionError, RpcError,
-                              RpcServer, loop_lag_watchdog, spawn)
+from ray_tpu.core.node.transfer import TransferManager
+from ray_tpu.core.rpc import (RawResult, RpcClient, RpcConnectionError,
+                              RpcError, RpcServer, loop_lag_watchdog, spawn)
 from ray_tpu.core.shm_store import ShmObjectStore, ShmReader, ShmWriter
 from ray_tpu.utils.logging import get_logger
 
@@ -126,6 +127,16 @@ class NodeAgent:
             self._shm_probe_path = ""
         # object_id hex -> error flag (mirror of GCS metadata for local objs)
         self.error_objects: Set[str] = set()
+        # object_id hex -> (owner, contained): sealed-object metadata kept so
+        # a peer's pull gets it piggybacked on the first chunk reply instead
+        # of paying a post-transfer object_info/GCS round trip (bounded FIFO)
+        from collections import OrderedDict as _OD
+
+        self._object_meta: "_OD[str, Tuple[str, Optional[List[str]]]]" = _OD()
+        # raw-frame transfer plane: pull manager + chunked-ingest writer
+        # cache + per-transfer stats (reference: ObjectManager pull/push)
+        self.transfer = TransferManager(self)
+        self.rpc.register_raw("receive_chunk_raw", self.transfer.open_ingest)
         self.gcs: Optional[RpcClient] = None
         self._workers: Dict[str, _WorkerHandle] = {}
         # idle task-pool workers, keyed by runtime-env hash ("" = plain):
@@ -163,6 +174,9 @@ class NodeAgent:
         self._unpin_event = asyncio.Event()
         self._unpin_flusher: Optional[asyncio.Task] = None
         self._peer_clients: Dict[str, RpcClient] = {}
+        # dedicated bulk-transfer connections per peer: multi-MB chunk
+        # payloads must not head-of-line-block control RPCs sharing a socket
+        self._transfer_clients: Dict[str, RpcClient] = {}
         self._peer_addr_cache: Dict[str, str] = {}
         self._hb_task: Optional[asyncio.Task] = None
         self._hb_client: Optional[RpcClient] = None  # dedicated heartbeat conn
@@ -280,9 +294,10 @@ class NodeAgent:
         if event.get("event") == "dead":
             node_id = event.get("node_id", "")
             self._peer_addr_cache.pop(node_id, None)
-            client = self._peer_clients.pop(node_id, None)
-            if client is not None:
-                spawn(client.close())
+            for pool in (self._peer_clients, self._transfer_clients):
+                client = pool.pop(node_id, None)
+                if client is not None:
+                    spawn(client.close())
 
     async def _log_monitor_loop(self) -> None:
         """Tail this node's worker logs and push NEW lines to the GCS
@@ -848,6 +863,7 @@ class NodeAgent:
         self.store.seal(oid)
         if is_error:
             self.error_objects.add(object_id)
+        self._remember_meta(object_id, owner, contained)
         # registration is BATCHED (one GCS RPC covers every seal that arrives
         # while the previous flush is in flight) but the ack WAITS for the
         # flush: "sealed" always implies "GCS-registered" (state API and
@@ -1019,6 +1035,79 @@ class NodeAgent:
         finally:
             reader.close()
 
+    def _remember_meta(self, object_id: str, owner: str = "",
+                       contained: Optional[List[str]] = None) -> None:
+        """Keep sealed-object metadata so peer pulls get is_error/owner/
+        contained piggybacked on their first chunk reply (bounded FIFO —
+        an evicted entry costs the puller nothing: owner/contained already
+        live at the GCS from the primary seal)."""
+        if not owner and not contained:
+            return
+        self._object_meta[object_id] = (owner,
+                                        list(contained) if contained else None)
+        while len(self._object_meta) > 20000:
+            self._object_meta.popitem(last=False)
+
+    async def rpc_read_chunk_raw(self, object_id: str, offset: int,
+                                 length: int, want_meta: bool = False) -> RawResult:
+        """Serve one chunk on the raw transfer plane: the reply payload is
+        the arena mapping itself (no bytes() copy, no msgpack encode). The
+        object is PINNED until the frame is written so LRU eviction cannot
+        recycle the slot mid-send; ``want_meta`` piggybacks is_error/owner/
+        contained on the reply so a pull costs exactly its data frames."""
+        oid = ObjectID.from_hex(object_id)
+        size = self.store.ensure_local(oid)
+        if size is None:
+            raise KeyError(f"object {object_id[:16]} not on node {self.hex[:8]}")
+        reader = ShmReader(oid, size, self.hex, offset=self.store.offset(oid))
+        self.store.pin(oid)
+        released = [False]
+
+        def release() -> None:
+            if not released[0]:
+                released[0] = True
+                self.store.unpin(oid)
+                reader.close()
+
+        try:
+            ln = max(0, min(length, size - offset))
+            view = reader.buffer[offset : offset + ln]
+            if not reader.revalidate():
+                raise KeyError(f"object {object_id[:16]} evicted mid-read")
+        except BaseException:
+            release()
+            raise
+        meta: Dict[str, Any] = {"size": size}
+        if want_meta:
+            owner, contained = self._object_meta.get(object_id, ("", None))
+            meta.update(has_meta=True,
+                        is_error=object_id in self.error_objects,
+                        owner=owner, contained=contained)
+        ts = self.transfer.stats
+        ts["chunks_out"] += 1
+        ts["bytes_out"] += ln
+        usage = self.store.usage()
+        if usage["used"] >= config.object_spilling_threshold * usage["capacity"]:
+            # store under pressure: a pin held across the socket write would
+            # block spill/eviction of exactly the objects that need to move
+            # (observed jamming a 10x-over-budget Data pipeline). Serve a
+            # copied chunk and release immediately — zero-copy stays the
+            # healthy-store fast path.
+            try:
+                data = bytes(view)
+                if not reader.revalidate():
+                    raise KeyError(
+                        f"object {object_id[:16]} evicted mid-read")
+            finally:
+                release()
+            return RawResult(meta, data)
+        return RawResult(meta, view, release)
+
+    async def rpc_transfer_stats(self) -> Dict[str, Any]:
+        """Per-transfer data-plane stats (pull/push bytes, bytes/s, stripe
+        sources, stalls, retries, failovers) for the dashboard + ray_perf."""
+        return self.transfer.snapshot()
+
     async def rpc_ensure_local(self, object_id: str, timeout_s: Optional[float] = None) -> Dict[str, Any]:
         """Make the object readable on this node, pulling if remote.
         Returns {size, is_error}. (named timeout_s: `timeout` is the RPC
@@ -1054,9 +1143,11 @@ class NodeAgent:
                                 "offset": self.store.offset(oid)}
                     remotes = [n for n in rec["locations"] if n != self.hex]
                     if remotes:
-                        ok = await self._pull(oid, rec["size"], remotes)
-                        if ok:
-                            if rec.get("owner", "").endswith(":error"):
+                        meta = await self._pull(oid, rec["size"], remotes,
+                                                owner_hint=rec.get("owner", ""))
+                        if meta is not None:
+                            if meta.get("is_error") or \
+                                    rec.get("owner", "").endswith(":error"):
                                 self.error_objects.add(object_id)
                             return {
                                 "size": rec["size"],
@@ -1193,7 +1284,65 @@ class NodeAgent:
         """Stream the object to one peer. Returns True if the peer NEWLY
         materialized it, False if it already held a sealed copy (detected on
         the first chunk — no wasted re-upload). A size-0 object still sends
-        one empty chunk so the receiver can reserve+seal."""
+        one empty chunk so the receiver can reserve+seal.
+
+        Raw plane: chunk payloads are arena memoryviews written straight to
+        the socket (object pinned for the duration — no bytes() copy, no
+        msgpack encode) with ``transfer_window_chunks`` sends in flight;
+        RTPU_RAW_TRANSFER=0 restores the serial in-band path."""
+        if not raw_transfer_enabled():
+            return await self._upload_object_to_legacy(client, oid,
+                                                       object_id, size)
+        reader = ShmReader(oid, size, self.hex, offset=self.store.offset(oid))
+        self.store.pin(oid)
+        try:
+            if not reader.revalidate():
+                raise KeyError(f"object {object_id[:16]} evicted mid-push")
+            owner, contained = self._object_meta.get(object_id, ("", None))
+            is_err = object_id in self.error_objects
+            chunk = config.fetch_chunk_bytes
+
+            async def send(off: int, n: int) -> Dict[str, Any]:
+                from ray_tpu.core.node.transfer import attempt_timeout
+
+                last_err: Optional[Exception] = None
+                for attempt in range(4):
+                    try:
+                        # re-sends are idempotent: the receiver's ingest
+                        # table dedupes by offset (chaos may drop frames);
+                        # short first deadline, doubling per retry
+                        return await client.call_raw_send(
+                            "receive_chunk_raw",
+                            reader.buffer[off : off + n],
+                            timeout=attempt_timeout(attempt),
+                            object_id=object_id, total_size=size, offset=off,
+                            is_error=is_err, owner=owner, contained=contained,
+                        )
+                    except TimeoutError as e:
+                        last_err = e
+                raise last_err  # type: ignore[misc]
+
+            resp = await send(0, min(chunk, size))
+            if isinstance(resp, dict) and resp.get("existing") == "sealed":
+                return False
+            sem = asyncio.Semaphore(max(1, int(config.transfer_window_chunks)))
+
+            async def one(off: int) -> None:
+                async with sem:
+                    await send(off, min(chunk, size - off))
+
+            await asyncio.gather(*(one(off)
+                                   for off in range(chunk, size, chunk)))
+            self.transfer.stats["bytes_out"] += size
+            return True
+        finally:
+            self.store.unpin(oid)
+            reader.close()
+
+    async def _upload_object_to_legacy(self, client: "RpcClient",
+                                       oid: ObjectID, object_id: str,
+                                       size: int) -> bool:
+        """Serial in-band msgpack chunk upload (pre-raw-plane baseline)."""
         reader = ShmReader(oid, size, self.hex, offset=self.store.offset(oid))
         try:
             sent = 0
@@ -1245,7 +1394,10 @@ class NodeAgent:
                     failed[head] = "no route"
                     continue
                 try:
-                    newly = await self._upload_object_to(client, oid,
+                    # bulk bytes ride the dedicated transfer connection so
+                    # they don't head-of-line-block control RPCs to the peer
+                    xfer = await self._transfer_peer(head) or client
+                    newly = await self._upload_object_to(xfer, oid,
                                                          object_id, size)
                 except (RpcError, RpcConnectionError, TimeoutError,
                         KeyError, OSError) as e:
@@ -1294,42 +1446,35 @@ class NodeAgent:
                                 offset: int, data: bytes,
                                 is_error: bool = False, owner: str = "",
                                 contained: Optional[List[str]] = None) -> Dict[str, Any]:
-        """Push-side ingest: chunks arrive in order from one pusher; the
-        first chunk reserves, the last seals + registers with the GCS."""
-        oid = ObjectID.from_hex(object_id)
-        if self.store.contains(oid):
-            return {"ok": True, "existing": "sealed"}
-        if offset == 0:
-            if self._reserve_idempotent(oid, total_size) == "sealed":
-                return {"ok": True, "existing": "sealed"}
-        else:
-            info = self.store.info(oid)
-            if info is None or info[0] != total_size:
-                # the reservation vanished mid-push (freed/aborted): fail
-                # loudly — writing into a fresh segment would seal nothing
-                # yet register this node with the GCS as a holder
-                raise KeyError(
-                    f"reservation for {object_id[:16]} vanished mid-push")
-        arena_off = self.store.offset(oid)
-        if arena_off is None and self.store.backend == "arena":
-            raise KeyError(
-                f"arena slot for {object_id[:16]} lost mid-push")
-        writer = ShmWriter(oid, total_size, self.hex, offset=arena_off)
-        if data:
-            writer.buffer[offset : offset + len(data)] = data
-        if offset + len(data) >= total_size:
-            writer.seal()
-            self.store.seal(oid)
-            if is_error:
-                self.error_objects.add(object_id)
-            await self.gcs.call("register_object", object_id=object_id,
-                                size=total_size, node_id=self.hex,
-                                owner=owner, contained=contained or None)
-        return {"ok": True}
+        """In-band (msgpack) chunk ingest — compat path and the
+        RTPU_RAW_TRANSFER=0 A/B baseline. Shares the per-object cached
+        ShmWriter ingest table with the raw plane instead of constructing a
+        fresh writer (attach + validate) for every chunk; seals + registers
+        with the GCS once every byte has landed."""
+        sink, finish = await self.transfer.open_ingest(
+            payload_len=len(data), object_id=object_id,
+            total_size=total_size, offset=offset, is_error=is_error,
+            owner=owner, contained=contained)
+        if sink is not None and data:
+            sink[: len(data)] = data
+        return await finish(len(data))
 
-    async def _pull(self, oid: ObjectID, size: int, locations: List[str]) -> bool:
-        """Chunked pull from a peer agent (reference: PullManager/PushManager
-        64MB chunks; here config.fetch_chunk_bytes)."""
+    async def _pull(self, oid: ObjectID, size: int, locations: List[str],
+                    owner_hint: str = "") -> Optional[Dict[str, Any]]:
+        """Materialize a remote object locally. Raw plane: striped windowed
+        pull with mid-object failover/resume (TransferManager); returns the
+        piggybacked metadata dict on success, None on failure.
+        RTPU_RAW_TRANSFER=0 restores the serial single-source msgpack path."""
+        if raw_transfer_enabled():
+            return await self.transfer.pull(oid, size, locations,
+                                            owner_hint=owner_hint)
+        ok = await self._pull_legacy(oid, size, locations)
+        return {} if ok else None
+
+    async def _pull_legacy(self, oid: ObjectID, size: int,
+                           locations: List[str]) -> bool:
+        """Serial chunked pull from one peer agent (pre-raw-plane baseline;
+        reference: PullManager/PushManager 64MB chunks)."""
         object_id = oid.hex()
         for node_id in locations:
             try:
@@ -1395,6 +1540,24 @@ class NodeAgent:
         self._peer_clients[node_id] = client
         return client
 
+    async def _transfer_peer(self, node_id: str) -> Optional[RpcClient]:
+        """Dedicated bulk-transfer connection to a peer (chunk payloads must
+        not queue control RPCs behind multi-MB reads on a shared socket)."""
+        client = self._transfer_clients.get(node_id)
+        if client is not None and not client._closed:  # noqa: SLF001
+            return client
+        if await self._peer(node_id) is None:  # resolves + caches the address
+            return None
+        addr = self._peer_addr_cache.get(node_id)
+        if addr is None:
+            return None
+        try:
+            client = await RpcClient(addr).connect(timeout=2.0)
+        except RpcConnectionError:
+            return None
+        self._transfer_clients[node_id] = client
+        return client
+
     async def rpc_wait_objects(
         self, object_ids: List[str], num_returns: int, timeout_s: Optional[float]
     ) -> List[str]:
@@ -1437,12 +1600,14 @@ class NodeAgent:
             # location (idempotent — a retried RPC re-frees nothing)
             self.store.delete(ObjectID.from_hex(object_id))
             self.error_objects.discard(object_id)
+            self._object_meta.pop(object_id, None)
             await self.gcs.call("free_object_everywhere", object_id=object_id)
         return True
 
     async def rpc_delete_local_object(self, object_id: str) -> bool:
         self.store.delete(ObjectID.from_hex(object_id))
         self.error_objects.discard(object_id)
+        self._object_meta.pop(object_id, None)
         return True
 
     # ------------------------------------------------------------ scheduling
@@ -2212,6 +2377,22 @@ class NodeAgent:
             len(self._workers))
         _gauge("ray_tpu_node_active_dispatches",
                "Tasks queued or running on this node").set(self._active_dispatches)
+        ts = self.transfer.stats
+        _gauge("ray_tpu_transfer_pull_bytes_total",
+               "Object bytes pulled from peers").set(ts["pull_bytes"])
+        _gauge("ray_tpu_transfer_ingest_bytes_total",
+               "Object bytes received via chunked ingest").set(ts["ingest_bytes"])
+        _gauge("ray_tpu_transfer_bytes_out_total",
+               "Object bytes served/pushed to peers").set(ts["bytes_out"])
+        _gauge("ray_tpu_transfer_pull_failovers_total",
+               "Pulls that failed over to another source mid-object").set(
+            ts["pull_failovers"])
+        _gauge("ray_tpu_transfer_stalls_total",
+               "Chunk requests delayed by the in-flight-bytes budget").set(
+            ts["stalls"])
+        _gauge("ray_tpu_transfer_last_pull_mbps",
+               "Throughput of the most recent completed pull").set(
+            ts["last_pull"].get("mbps", 0.0))
         for res in ("CPU", "TPU"):
             if res in self.total_resources:
                 _gauge("ray_tpu_resource_available", "Available resource units",

@@ -12,6 +12,24 @@ manager, deadlines, retries) + rpc_chaos.{h,cc} fault injection. Design:
 
 Binary values pass through msgpack natively (use_bin_type). Handlers are
 ``async def handler(**params) -> result``.
+
+RAW frames (the object-byte transfer plane; reference: ObjectManager
+multi-stream chunked transfer, object_manager.h:117): a frame whose length
+word has the top bit set carries a small msgpack header plus an opaque
+payload that never touches msgpack —
+
+- raw frame = [u32 (RAW_FLAG | length)][u16 header_len][msgpack header][payload]
+- raw request:  header {"i": id, "m": method, "p": params}; the server routes
+  to a handler registered with ``register_raw`` which supplies a writable
+  memoryview BEFORE the payload is read, so bytes go socket -> arena slot
+  with no intermediate buffer; the reply is a normal msgpack response.
+- raw response: a normal handler returns ``RawResult(meta, payload)`` and the
+  payload memoryview is written straight from the arena mapping; the client
+  issued the call with ``call_raw(method, sink, ...)`` and the sink provides
+  the destination buffer the read loop copies the payload into.
+- chaos also covers raw frames: requests/responses drop (payload drained to
+  keep the stream framed) and responses may be TRUNCATED (frame stays
+  consistent, fewer payload bytes than asked) to exercise resume paths.
 """
 
 from __future__ import annotations
@@ -32,9 +50,28 @@ logger = get_logger("rpc")
 
 MAX_FRAME = 1 << 31
 
+# Top bit of the length word marks a raw binary frame (header + payload);
+# plain frame lengths are capped well below it by rpc_max_message_bytes.
+RAW_FLAG = 0x80000000
+
 # Sentinel: "use the configured default deadline". Pass timeout=None for an
 # INFINITE deadline (long-running task pushes, blocking gets).
 DEFAULT_TIMEOUT = object()
+
+
+class RawResult:
+    """Returned by a handler to answer with a RAW frame: ``payload`` (any
+    bytes-like, typically an arena memoryview) is written to the socket
+    without msgpack encoding; ``meta`` is the small msgpack header the
+    client's sink sees. ``release`` (if set) runs after the frame is written
+    — unpin/close whatever kept the payload memory valid."""
+
+    __slots__ = ("meta", "payload", "release")
+
+    def __init__(self, meta: Dict[str, Any], payload, release=None):
+        self.meta = meta
+        self.payload = payload
+        self.release = release
 
 
 class RpcError(Exception):
@@ -61,6 +98,47 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
     return msgpack.unpackb(body, raw=False, strict_map_key=False)
 
 
+async def _read_raw_header(
+    reader: asyncio.StreamReader, length: int
+) -> Tuple[Dict[str, Any], int]:
+    """After a RAW length word: parse the msgpack header, return it plus the
+    number of payload bytes that FOLLOW on the stream (not yet consumed)."""
+    (hlen,) = struct.unpack("<H", await reader.readexactly(2))
+    header = msgpack.unpackb(await reader.readexactly(hlen), raw=False,
+                             strict_map_key=False)
+    return header, length - 2 - hlen
+
+
+async def _read_into(reader: asyncio.StreamReader, view: memoryview,
+                     n: int) -> None:
+    """Read exactly n bytes from the stream directly into ``view`` (the
+    caller-provided destination — an arena slot slice) with no intermediate
+    whole-payload buffer."""
+    pos = 0
+    while pos < n:
+        data = await reader.read(n - pos)
+        if not data:
+            raise asyncio.IncompleteReadError(b"", n - pos)
+        view[pos:pos + len(data)] = data
+        pos += len(data)
+
+
+async def _drain_payload(reader: asyncio.StreamReader, n: int) -> None:
+    """Consume and discard n payload bytes (unroutable/chaos-dropped raw
+    frame): the stream must stay framed."""
+    while n > 0:
+        data = await reader.read(min(n, 1 << 18))
+        if not data:
+            raise asyncio.IncompleteReadError(b"", n)
+        n -= len(data)
+
+
+def _pack_raw(header: Dict[str, Any], payload_len: int) -> bytes:
+    body = msgpack.packb(header, use_bin_type=True)
+    return struct.pack("<IH", RAW_FLAG | (2 + len(body) + payload_len),
+                       len(body)) + body
+
+
 class _Chaos:
     """Seeded fault injector. Beyond request/response drops it also covers
     the pipelined control-plane frames: pushed completion events
@@ -81,6 +159,11 @@ class _Chaos:
     # (one seeded stream) so runs stay reproducible
     should_drop_push = should_drop
     should_drop_inline = should_drop
+    # raw transfer plane: dropped raw requests/responses and TRUNCATED raw
+    # payloads (frame consistent, fewer bytes than asked) exercise the pull
+    # manager's re-request/failover/resume paths
+    should_drop_raw = should_drop
+    should_truncate_raw = should_drop
 
 
 # Methods a client may transparently re-send after a (possibly chaos-induced)
@@ -137,7 +220,7 @@ RETRY_SAFE_METHODS = frozenset({
     "schedule", "lookup_object", "register_object", "register_objects",
     "pin_tasks", "remove_object_location",
     "object_info", "object_sizes", "read_chunk", "free_object_everywhere",
-    "delete_local_object",
+    "delete_local_object", "transfer_stats",
     # idempotent ensure/wait/push surface: a dropped frame must cost one
     # attempt window, not the caller's whole deadline (broadcast under 5%
     # chaos burned 125s on one lost ensure_local request, r5)
@@ -152,6 +235,9 @@ RETRY_SAFE_METHODS = frozenset({
     "get_actor", "get_actor_spec", "get_named_actor", "list_named_actors",
     "list_actors", "actor_started", "placement_group_info",
     "placement_group_table", "reserve_bundle", "return_bundle",
+    # create dedupes by pg_id at the GCS (first attempt wins); remove's
+    # second attempt no-ops on the already-popped record
+    "create_placement_group", "remove_placement_group",
     "create_object", "seal_object", "abort_object", "store_error", "put_object",
     "stream_put", "stream_end", "stream_next", "stream_wait", "stream_close",
     "stream_state",
@@ -176,6 +262,9 @@ class RpcServer:
         self.host = host
         self.port = port
         self._handlers: Dict[str, Callable[..., Awaitable[Any]]] = {}
+        # raw ingest handlers: name -> async fn(payload_len=..., **params)
+        # returning (sink_view_or_None, finish) — see register_raw
+        self._raw_handlers: Dict[str, Callable[..., Awaitable[Any]]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         # channel -> set of writer
         self._subscribers: Dict[str, set] = {}
@@ -193,6 +282,15 @@ class RpcServer:
 
     def register(self, name: str, fn: Callable[..., Awaitable[Any]]) -> None:
         self._handlers[name] = fn
+
+    def register_raw(self, name: str, open_fn: Callable[..., Awaitable[Any]]) -> None:
+        """Register an inbound-raw-frame handler. ``open_fn(payload_len=N,
+        **params)`` runs BEFORE the payload is read and returns
+        ``(sink, finish)``: ``sink`` is a writable memoryview of >= N bytes
+        the payload is received into directly (None = drain/discard), and
+        ``await finish(nbytes)`` runs after the payload landed, returning
+        the msgpack reply value."""
+        self._raw_handlers[name] = open_fn
 
     def register_object(self, obj: Any, prefix: str = "") -> None:
         """Every ``async def rpc_*`` method becomes a handler."""
@@ -231,7 +329,18 @@ class RpcServer:
         self._writer_locks[writer] = asyncio.Lock()
         try:
             while True:
-                msg = await _read_frame(reader)
+                head = await reader.readexactly(4)
+                (word,) = struct.unpack("<I", head)
+                if word & RAW_FLAG:
+                    # raw frames are consumed INLINE: the payload bytes
+                    # follow on this stream and must land in their sink (or
+                    # be drained) before the next frame can be parsed
+                    await self._handle_raw(word & ~RAW_FLAG, reader, writer)
+                    continue
+                if word > config.rpc_max_message_bytes:
+                    raise ValueError(f"frame of {word} bytes exceeds limit")
+                body = await reader.readexactly(word)
+                msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
                 spawn(self._dispatch(msg, writer))
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
@@ -245,6 +354,58 @@ class RpcServer:
                 writer.close()
             except Exception:
                 pass
+
+    async def _handle_raw(self, length: int, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        """One inbound raw frame: parse header, obtain the sink from the
+        registered handler, receive the payload straight into it, then run
+        the handler's finish step off-loop (reply rides a normal msgpack
+        response frame)."""
+        header, payload_len = await _read_raw_header(reader, length)
+        req_id = header.get("i")
+        method = header.get("m", "")
+        if self._chaos.should_drop_raw():
+            logger.warning("rpc chaos: dropping raw request %s", method)
+            await _drain_payload(reader, payload_len)
+            return
+        fn = self._raw_handlers.get(method)
+        if fn is None:
+            await _drain_payload(reader, payload_len)
+            await self._reply(writer, {"i": req_id,
+                                       "e": ["KeyError", f"no raw handler {method!r}"]})
+            return
+        try:
+            sink, finish = await fn(payload_len=payload_len,
+                                    **(header.get("p") or {}))
+        except Exception as e:  # noqa: BLE001 - serialize handler errors
+            await _drain_payload(reader, payload_len)
+            await self._reply(writer, {"i": req_id,
+                                       "e": [type(e).__name__, str(e)]})
+            return
+        if sink is None or len(sink) < payload_len:
+            # no sink (discard) or an undersized one (malformed offset/len):
+            # drain so the stream stays framed either way
+            await _drain_payload(reader, payload_len)
+            if sink is not None:
+                await self._reply(writer, {"i": req_id,
+                                           "e": ["ValueError",
+                                                 "payload exceeds sink"]})
+                return
+        else:
+            await _read_into(reader, sink, payload_len)
+        spawn(self._finish_raw(req_id, finish, payload_len, writer))
+
+    async def _finish_raw(self, req_id, finish, nbytes: int,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            result = await finish(nbytes)
+            resp = {"i": req_id, "r": result}
+        except Exception as e:  # noqa: BLE001
+            resp = {"i": req_id, "e": [type(e).__name__, str(e)]}
+        if self._chaos.should_drop_raw():
+            logger.warning("rpc chaos: dropping raw-ingest response")
+            return
+        await self._reply(writer, resp)
 
     async def _dispatch(self, msg: Dict, writer: asyncio.StreamWriter) -> None:
         req_id = msg.get("i")
@@ -272,6 +433,9 @@ class RpcServer:
             return
         try:
             result = await fn(**(msg.get("p") or {}))
+            if isinstance(result, RawResult):
+                await self._reply_raw(writer, req_id, result)
+                return
             resp = {"i": req_id, "r": result}
         except Exception as e:  # noqa: BLE001 - serialize handler errors to caller
             resp = {"i": req_id, "e": [type(e).__name__, str(e)]}
@@ -279,6 +443,39 @@ class RpcServer:
             logger.warning("rpc chaos: dropping response for %s", method)
             return
         await self._reply(writer, resp)
+
+    async def _reply_raw(self, writer: asyncio.StreamWriter, req_id,
+                         result: RawResult) -> None:
+        """Answer with a raw frame: payload memoryview written straight to
+        the transport — no msgpack encode, no bytes() copy. Chaos may drop
+        the whole response (caller re-requests the chunk) or truncate the
+        payload (frame stays consistent; caller re-requests the tail)."""
+        payload = memoryview(result.payload)
+        try:
+            if self._chaos.should_drop_raw():
+                logger.warning("rpc chaos: dropping raw response")
+                return
+            if len(payload) > 0 and self._chaos.should_truncate_raw():
+                logger.warning("rpc chaos: truncating raw response payload")
+                payload = payload[: max(1, len(payload) // 2)]
+            frame = _pack_raw({"i": req_id, "r": result.meta}, len(payload))
+            lock = self._writer_locks.get(writer)
+            if lock is None:
+                return
+            async with lock:
+                try:
+                    writer.write(frame)
+                    if len(payload):
+                        writer.write(payload)
+                    await writer.drain()
+                except (ConnectionResetError, BrokenPipeError):
+                    pass
+        finally:
+            if result.release is not None:
+                try:
+                    result.release()
+                except Exception:  # noqa: BLE001
+                    logger.exception("raw-result release failed")
 
     async def _reply(self, writer: asyncio.StreamWriter, obj: Any) -> None:
         lock = self._writer_locks.get(writer)
@@ -328,6 +525,9 @@ class RpcClient:
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
+        # req_id -> sink callable for in-flight call_raw requests: the read
+        # loop hands the raw payload straight into the buffer it returns
+        self._raw_sinks: Dict[int, Callable[[Any, int], Optional[memoryview]]] = {}
         self._ids = itertools.count(1)
         self._read_task: Optional[asyncio.Task] = None
         self._sub_callbacks: Dict[str, Callable[[Any], None]] = {}
@@ -359,7 +559,15 @@ class RpcClient:
         reader = self._reader
         try:
             while True:
-                msg = await _read_frame(reader)
+                head = await reader.readexactly(4)
+                (word,) = struct.unpack("<I", head)
+                if word & RAW_FLAG:
+                    await self._on_raw_response(reader, word & ~RAW_FLAG)
+                    continue
+                if word > config.rpc_max_message_bytes:
+                    raise ValueError(f"frame of {word} bytes exceeds limit")
+                body = await reader.readexactly(word)
+                msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
                 if "c" in msg:  # pubsub push
                     cb = self._sub_callbacks.get(msg["c"])
                     if cb is not None:
@@ -387,6 +595,97 @@ class RpcClient:
                         fut.set_exception(RpcConnectionError("connection lost"))
                         fut.exception()  # caller may have timed out: mark retrieved
                 self._pending.clear()
+                self._raw_sinks.clear()
+
+    async def _on_raw_response(self, reader: asyncio.StreamReader,
+                               length: int) -> None:
+        """A raw response frame: route the payload into the caller-provided
+        sink buffer (registered by call_raw) with no intermediate copy; a
+        late/unclaimed payload is drained."""
+        header, payload_len = await _read_raw_header(reader, length)
+        req_id = header.get("i")
+        sink = self._raw_sinks.pop(req_id, None)
+        fut = self._pending.pop(req_id, None)
+        view: Optional[memoryview] = None
+        if sink is not None and fut is not None and not fut.done():
+            try:
+                view = sink(header.get("r"), payload_len)
+            except Exception:  # noqa: BLE001 - sink failure = discard
+                logger.exception("raw sink failed")
+                view = None
+        if view is not None and len(view) < payload_len:
+            view = None  # undersized sink: discard rather than desync
+        if view is None or payload_len == 0:
+            await _drain_payload(reader, payload_len)
+            if view is None:
+                payload_len = 0  # nothing landed in the caller's buffer
+        else:
+            await _read_into(reader, view, payload_len)
+        if fut is not None and not fut.done():
+            if "e" in header:
+                fut.set_exception(RpcError(*header["e"]))
+            else:
+                fut.set_result({"meta": header.get("r"), "nbytes": payload_len})
+
+    async def call_raw(self, method: str, sink, timeout: Optional[float] = None,
+                       **params) -> Dict[str, Any]:
+        """Request whose RESPONSE is a raw frame. ``sink(meta, nbytes)`` is
+        invoked by the read loop when the response header arrives and must
+        return a writable memoryview of >= nbytes (or None to discard); the
+        payload is received directly into it. Returns {"meta", "nbytes"}.
+        No transparent retry — transfer callers own re-request/failover."""
+        if self._closed:
+            raise RpcConnectionError("client closed")
+        req_id = next(self._ids)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        self._raw_sinks[req_id] = sink
+        try:
+            async with self._send_lock:
+                self._writer.write(_pack({"i": req_id, "m": method, "p": params}))
+                await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._pending.pop(req_id, None)
+            self._raw_sinks.pop(req_id, None)
+            raise RpcConnectionError(f"send failed: {e}") from None
+        try:
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise TimeoutError(f"rpc {method} timed out after {timeout}s") from None
+        finally:
+            self._raw_sinks.pop(req_id, None)
+
+    async def call_raw_send(self, method: str, payload,
+                            timeout: Optional[float] = None, **params) -> Any:
+        """Raw REQUEST: ``payload`` (bytes-like / memoryview, e.g. an arena
+        slice) rides after the small msgpack header with no msgpack encode
+        and no bytes() copy; the reply is a normal msgpack response."""
+        if self._closed:
+            raise RpcConnectionError("client closed")
+        view = memoryview(payload)
+        req_id = next(self._ids)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            async with self._send_lock:
+                self._writer.write(
+                    _pack_raw({"i": req_id, "m": method, "p": params}, len(view)))
+                if len(view):
+                    self._writer.write(view)
+                await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._pending.pop(req_id, None)
+            raise RpcConnectionError(f"send failed: {e}") from None
+        try:
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise TimeoutError(f"rpc {method} timed out after {timeout}s") from None
 
     async def call(self, method: str, timeout: Any = DEFAULT_TIMEOUT, **params) -> Any:
         if timeout is DEFAULT_TIMEOUT:
@@ -451,6 +750,7 @@ class RpcClient:
                     fut.set_exception(RpcConnectionError("connection lost"))
                     fut.exception()  # caller may have timed out: mark retrieved
             self._pending.clear()
+            self._raw_sinks.clear()
             self._reader, self._writer = reader, writer
             self._closed = False
             self._conn_gen += 1
@@ -512,6 +812,7 @@ class RpcClient:
                 fut.set_exception(RpcConnectionError("client closed"))
                 fut.exception()  # caller may never retrieve: mark consumed
         self._pending.clear()
+        self._raw_sinks.clear()
         if self._read_task is not None:
             self._read_task.cancel()
             try:
@@ -560,6 +861,38 @@ class SyncRpcClient:
             raise RpcConnectionError("client closed")
         return asyncio.run_coroutine_threadsafe(
             self._client.call(method, timeout=timeout, **params), self._loop
+        )
+
+    def call_raw(self, method: str, sink, timeout: Optional[float] = None,
+                 **params) -> Dict[str, Any]:
+        """Raw-response call; ``sink`` runs on the client loop thread."""
+        return self._run(self._client.call_raw(method, sink, timeout=timeout,
+                                               **params))
+
+    def call_raw_send(self, method: str, payload,
+                      timeout: Optional[float] = None, **params) -> Any:
+        return self._run(self._client.call_raw_send(method, payload,
+                                                    timeout=timeout, **params))
+
+    def call_raw_send_async(self, method: str, payload,
+                            timeout: Optional[float] = None, **params):
+        """Pipelined raw send: returns a concurrent.futures.Future so a
+        caller can keep a window of chunk uploads in flight (streaming
+        put)."""
+        if self._stopped or not self._thread.is_alive():
+            raise RpcConnectionError("client closed")
+        return asyncio.run_coroutine_threadsafe(
+            self._client.call_raw_send(method, payload, timeout=timeout,
+                                       **params), self._loop
+        )
+
+    def call_raw_async(self, method: str, sink,
+                       timeout: Optional[float] = None, **params):
+        if self._stopped or not self._thread.is_alive():
+            raise RpcConnectionError("client closed")
+        return asyncio.run_coroutine_threadsafe(
+            self._client.call_raw(method, sink, timeout=timeout, **params),
+            self._loop
         )
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:

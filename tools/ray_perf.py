@@ -6,11 +6,18 @@ Usage:
     python tools/ray_perf.py --cluster       # real multi-process cluster (1 node)
     python tools/ray_perf.py --cluster --no-pipeline   # lockstep control plane
     python tools/ray_perf.py --cluster --smoke         # fast CI smoke preset
+    python tools/ray_perf.py --cluster --transfer      # + data-plane MB/s
+    python tools/ray_perf.py --cluster --transfer --no-raw-transfer  # A/B
+    python tools/ray_perf.py --cluster --transfer --no-stripe        # A/B
     python tools/ray_perf.py --cluster --out results.json
 
 Prints one JSON line per metric. --no-pipeline sets RTPU_PIPELINE=0 before
 the cluster starts (inherited by every agent/worker), so regressions are
-attributable to the pipelined control plane vs the lockstep one.
+attributable to the pipelined control plane vs the lockstep one. The same
+pattern covers the DATA plane: --no-raw-transfer sets RTPU_RAW_TRANSFER=0
+(serial in-band msgpack chunks) and --no-stripe disables multi-source
+striping, so `cluster_transfer_mbps_*` deltas are attributable to the raw
+transfer plane / striping specifically.
 """
 
 import argparse
@@ -34,6 +41,98 @@ def bench(name, fn, n, results, unit="ops/s"):
     return rate
 
 
+def transfer_benchmarks(cluster, results, smoke: bool = False) -> None:
+    """Data-plane throughput: node-to-node pull, binomial broadcast, and a
+    striped 2-source pull, per object size. Spins two extra agents on this
+    host; MB/s = payload bytes / wall seconds (1 MB = 1e6 bytes)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.core.rpc import SyncRpcClient
+    from ray_tpu.experimental.broadcast import broadcast
+
+    from ray_tpu.core.worker import global_worker
+
+    sizes = [1 << 20, 16 << 20] if smoke else [1 << 20, 16 << 20, 64 << 20]
+    n2 = cluster.add_node(num_cpus=1)
+    n3 = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(3, timeout=60)
+    agent2 = SyncRpcClient(n2.address)
+    agent3 = SyncRpcClient(n3.address)
+    runtime = global_worker().runtime
+    try:
+        reps = 3  # best-of-N: this class of host is heavily co-tenant
+        for size in sizes:
+            label = f"{size >> 20}MiB"
+            payload = np.random.default_rng(0).integers(
+                0, 255, size, dtype=np.uint8)
+            # ---- single-destination pull (node2 fetches from the holders)
+            best, stripe_best, sources = 0.0, 0.0, []
+            for rep in range(reps):
+                ref = ray_tpu.put(payload)
+                t0 = time.perf_counter()
+                agent2.call("ensure_local", object_id=ref.id.hex(),
+                            timeout_s=300.0, timeout=310.0)
+                dt = time.perf_counter() - t0
+                best = max(best, size / dt / 1e6)
+                # ---- striped pull: node3 sees TWO holders (head + node2)
+                t0 = time.perf_counter()
+                agent3.call("ensure_local", object_id=ref.id.hex(),
+                            timeout_s=300.0, timeout=310.0)
+                dt = time.perf_counter() - t0
+                if size / dt / 1e6 > stripe_best:
+                    stripe_best = size / dt / 1e6
+                    stats = agent3.call("transfer_stats")
+                    sources = (stats.get("last_pull") or {}).get("sources", [])
+                ray_tpu.free([ref])
+            emit(results, f"cluster_transfer_pull_mbps_{label}",
+                 best, "MB/s", size)
+            emit(results, f"cluster_transfer_striped_pull_mbps_{label}",
+                 stripe_best, "MB/s", size,
+                 extra={"stripe_sources": sources})
+            # ---- broadcast (binomial tree to both extra nodes)
+            best = 0.0
+            for rep in range(reps):
+                ref = ray_tpu.put(payload)
+                t0 = time.perf_counter()
+                broadcast(ref, timeout=300.0)
+                dt = time.perf_counter() - t0
+                best = max(best, 2 * size / dt / 1e6)
+                ray_tpu.free([ref])
+            emit(results, f"cluster_broadcast_mbps_{label}",
+                 best, "MB/s", 2 * size)
+            # ---- client-plane streamed put (the path off-cluster drivers
+            # use: chunked into the agent store instead of one giant frame)
+            best = 0.0
+            for rep in range(reps):
+                runtime.remote_data_plane = True
+                try:
+                    t0 = time.perf_counter()
+                    ref = ray_tpu.put(payload)
+                    dt = time.perf_counter() - t0
+                finally:
+                    runtime.remote_data_plane = False
+                best = max(best, size / dt / 1e6)
+                ray_tpu.free([ref])
+            emit(results, f"cluster_client_put_mbps_{label}",
+                 best, "MB/s", size)
+        # headline metric for trajectory tracking
+        results["cluster_transfer_mbps"] = results.get(
+            "cluster_transfer_pull_mbps_16MiB", 0.0)
+    finally:
+        agent2.close()
+        agent3.close()
+
+
+def emit(results, name, value, unit, nbytes, extra=None):
+    rec = {"metric": name, "value": round(value, 1), "unit": unit,
+           "bytes": nbytes}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec))
+    results[name] = round(value, 1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cluster", action="store_true",
@@ -43,6 +142,15 @@ def main() -> None:
     parser.add_argument("--no-pipeline", action="store_true",
                         help="lockstep control plane (sets RTPU_PIPELINE=0 "
                              "for this process tree)")
+    parser.add_argument("--transfer", action="store_true",
+                        help="also measure data-plane transfer throughput "
+                             "(pull/broadcast/striped pull; needs --cluster)")
+    parser.add_argument("--no-raw-transfer", action="store_true",
+                        help="serial in-band msgpack data plane (sets "
+                             "RTPU_RAW_TRANSFER=0 for this process tree)")
+    parser.add_argument("--no-stripe", action="store_true",
+                        help="single-source pulls (disables multi-source "
+                             "striping for this process tree)")
     parser.add_argument("--smoke", action="store_true",
                         help="fast CI smoke preset (implies --scale 0.05)")
     parser.add_argument("--out", default=None,
@@ -51,6 +159,10 @@ def main() -> None:
 
     if args.no_pipeline:
         os.environ["RTPU_PIPELINE"] = "0"
+    if args.no_raw_transfer:
+        os.environ["RTPU_RAW_TRANSFER"] = "0"
+    if args.no_stripe:
+        os.environ["RAY_TPU_PULL_STRIPE_ENABLED"] = "0"
     if args.smoke:
         args.scale = min(args.scale, 0.05)
 
@@ -101,13 +213,18 @@ def main() -> None:
     bench(f"{mode}_batched_get_per_sec", batched_get, int(1000 * s), results)
     bench(f"{mode}_actor_calls_per_sec", actor_calls, int(500 * s), results)
 
+    if args.transfer and cluster is not None:
+        transfer_benchmarks(cluster, results, smoke=args.smoke)
+
     if args.out:
-        from ray_tpu.core.config import pipeline_enabled
+        from ray_tpu.core.config import pipeline_enabled, raw_transfer_enabled
 
         with open(args.out, "a") as f:
             f.write(json.dumps({
                 "mode": mode,
                 "pipeline": pipeline_enabled(),
+                "raw_transfer": raw_transfer_enabled(),
+                "stripe": not args.no_stripe,
                 "scale": s,
                 "results": results,
             }) + "\n")
