@@ -662,6 +662,7 @@ class ClusterRuntime(CoreRuntime):
         # chunks and re-sent on RPC timeout (ensure_local is idempotent),
         # so one dropped frame doesn't consume the whole user deadline —
         # and a timeout=None get still survives connection hiccups.
+        store_full_retries = 0
         while True:
             if self.pipelined:
                 # a pushed completion may land while we poll — and an
@@ -690,6 +691,16 @@ class ClusterRuntime(CoreRuntime):
                 remaining is None or remaining > attempt_s
             ):
                 continue  # per-object timeout but user deadline remains
+            if any(i.get("error_type") == "ObjectStoreFullError"
+                   for i in infos) and store_full_retries < 40:
+                # transient local pressure (a fragmented/pinned-out arena
+                # while other pulls are in flight — e.g. a shuffle's reduce
+                # outputs landing): pins drop and spill frees space as
+                # tasks finish, so back off and re-ensure instead of
+                # failing the get
+                store_full_retries += 1
+                time.sleep(min(1.0, 0.05 * store_full_retries))
+                continue
             break
         for h, info in zip(ids, infos):
             if "error" in info:
@@ -697,6 +708,8 @@ class ClusterRuntime(CoreRuntime):
                     raise exc.GetTimeoutError(
                         f"get() timed out waiting for {h[:16]}"
                     )
+                if info.get("error_type") == "ObjectStoreFullError":
+                    raise exc.ObjectStoreFullError(info["error"])
                 raise exc.ObjectLostError(h, info["error"])
             oid = ObjectID.from_hex(h)
             for attempt in range(4):

@@ -287,6 +287,26 @@ _DEFINITIONS = [
      "Max un-consumed output blocks per physical Data operator (its output "
      "queue + the downstream input queue) before the downstream-capacity "
      "backpressure policy stops its dispatches."),
+    # --- data: streaming distributed shuffle ---
+    ("streaming_shuffle_enabled", True, bool,
+     "Streaming shuffle subsystem for sort/groupby/repartition/"
+     "random_shuffle: map-side partitioner tasks run as each upstream block "
+     "lands (no driver barrier), reduce tasks are admitted under a "
+     "spill-aware memory budget. Escape hatch: env RTPU_STREAMING_SHUFFLE=0 "
+     "restores the AllToAllOp barrier exchange for A/B."),
+    ("shuffle_default_partitions", 8, int,
+     "Reducer count for a shuffle whose stage doesn't pin one when the "
+     "upstream block count is unknown (iterator sources, unions)."),
+    ("shuffle_admission_memory_fraction", 0.5, float,
+     "Fraction of the Data memory budget the in-flight reduce partition "
+     "sets of one shuffle may occupy. Beyond it, reduce admission DEFERS "
+     "(map partition blocks stay at rest in the store, spilling under "
+     "pressure) instead of pulling the whole exchange into memory — how a "
+     "shuffle larger than aggregate arena memory completes."),
+    ("transfer_register_batch_ms", 1.0, float,
+     "Coalescing window for GCS object registrations on the transfer plane "
+     "(pulled partition blocks register in one batched RPC per tick, not "
+     "one round trip per block)."),
 ]
 
 
@@ -312,6 +332,17 @@ def raw_transfer_enabled() -> bool:
     if raw is not None:
         return raw.strip().lower() not in ("0", "false", "no", "off")
     return config.raw_transfer_enabled
+
+
+def streaming_shuffle_enabled() -> bool:
+    """Streaming shuffle subsystem on/off. The RTPU_STREAMING_SHUFFLE env
+    var is the operator escape hatch (tools/bench_shuffle.py --no-streaming
+    sets it) and wins over the config entry so one process tree can be
+    flipped wholesale for A/B against the AllToAllOp barrier exchange."""
+    raw = os.environ.get("RTPU_STREAMING_SHUFFLE")
+    if raw is not None:
+        return raw.strip().lower() not in ("0", "false", "no", "off")
+    return config.streaming_shuffle_enabled
 
 
 def inline_max_bytes() -> int:

@@ -981,6 +981,15 @@ class GcsServer:
             "lost": not rec["locations"] and rec.get("had_locations", False),
         }
 
+    async def rpc_lookup_objects(
+        self, object_ids: List[str]
+    ) -> List[Optional[Dict[str, Any]]]:
+        """Batched holder lookup: one RPC resolves a whole partition set
+        (a shuffle reduce task's N map-partition deps) instead of N
+        round trips. Each record gets the same per-lookup holder rotation
+        as ``lookup_object``."""
+        return [await self.rpc_lookup_object(o) for o in object_ids]
+
     async def rpc_register_objects(self, regs: List[Dict[str, Any]]) -> bool:
         """Batched object registration: one RPC covers every object an agent
         sealed in the last coalescing tick (cuts a GCS round trip off every

@@ -1108,10 +1108,16 @@ class NodeAgent:
         sources, stalls, retries, failovers) for the dashboard + ray_perf."""
         return self.transfer.snapshot()
 
-    async def rpc_ensure_local(self, object_id: str, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+    async def rpc_ensure_local(self, object_id: str,
+                               timeout_s: Optional[float] = None,
+                               rec_hint: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Make the object readable on this node, pulling if remote.
         Returns {size, is_error}. (named timeout_s: `timeout` is the RPC
-        client's own deadline kwarg)"""
+        client's own deadline kwarg). ``rec_hint``: a directory record a
+        BATCHED lookup already resolved — the first iteration skips the
+        per-object GCS long-poll (partition-set pulls cost one lookup RPC
+        for the whole set, not one per block); a stale hint falls through
+        to the long-poll on the next iteration."""
         oid = ObjectID.from_hex(object_id)
         deadline = time.monotonic() + (timeout_s if timeout_s is not None else 1e18)
         lock = self._pull_locks.setdefault(object_id, asyncio.Lock())
@@ -1122,20 +1128,22 @@ class NodeAgent:
                         "offset": self.store.offset(oid)}
             # remote: resolve location via GCS long-poll (event-driven — the
             # GCS wakes us on register/lost instead of us re-polling lookup)
+            rec = rec_hint
             while True:
-                chunk = min(2.0, max(0.05, deadline - time.monotonic()))
-                try:
-                    rec = await self.gcs.call(
-                        "wait_object_located", object_id=object_id,
-                        timeout_s=chunk, timeout=chunk + 5.0,
-                    )
-                except (TimeoutError, RpcError):  # chaos-dropped frame: re-poll
-                    rec = None
-                except (RpcConnectionError, OSError):
-                    # GCS down/restarting: the heartbeat loop reconnects the
-                    # shared client; back off instead of failing the wait
-                    await asyncio.sleep(0.2)
-                    rec = None
+                if rec is None:
+                    chunk = min(2.0, max(0.05, deadline - time.monotonic()))
+                    try:
+                        rec = await self.gcs.call(
+                            "wait_object_located", object_id=object_id,
+                            timeout_s=chunk, timeout=chunk + 5.0,
+                        )
+                    except (TimeoutError, RpcError):  # chaos-dropped frame: re-poll
+                        rec = None
+                    except (RpcConnectionError, OSError):
+                        # GCS down/restarting: the heartbeat loop reconnects the
+                        # shared client; back off instead of failing the wait
+                        await asyncio.sleep(0.2)
+                        rec = None
                 if rec and rec["locations"]:
                     if self.hex in rec["locations"] and self.store.contains(oid):
                         return {"size": rec["size"],
@@ -1166,9 +1174,11 @@ class NodeAgent:
                     # task_manager.h:468). Raises if no lineage or the
                     # reconstruction budget is exhausted.
                     await self._reconstruct(object_id)
+                    rec = None
                     continue  # lookup again: the re-run registered locations
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"object {object_id[:16]} not available")
+                rec = None  # hint consumed/stale: long-poll next iteration
 
     async def rpc_ensure_local_batch(
         self, object_ids: List[str], timeout_s: Optional[float] = None
@@ -1182,10 +1192,11 @@ class NodeAgent:
         deadline = time.monotonic() + (timeout_s if timeout_s is not None else 1e18)
         out: Dict[str, Dict[str, Any]] = {}
 
-        async def _finish(object_id: str) -> None:
+        async def _finish(object_id: str, rec_hint=None) -> None:
             try:
                 out[object_id] = await self.rpc_ensure_local(
-                    object_id, timeout_s=max(0.05, deadline - time.monotonic())
+                    object_id, timeout_s=max(0.05, deadline - time.monotonic()),
+                    rec_hint=rec_hint,
                 )
             except BaseException as res:  # noqa: BLE001
                 out[object_id] = {
@@ -1217,7 +1228,19 @@ class NodeAgent:
                 await asyncio.sleep(0.2)
                 located = []
             if located:
-                await asyncio.gather(*[_finish(o) for o in located])
+                # ONE batched holder lookup for the whole located set (a
+                # shuffle reduce's partition set resolves in a single RPC);
+                # each record rides into rpc_ensure_local as its first-
+                # iteration hint, skipping the per-object long-poll
+                try:
+                    recs = await self.gcs.call("lookup_objects",
+                                               object_ids=located,
+                                               timeout=10.0)
+                except (TimeoutError, RpcError, RpcConnectionError, OSError):
+                    recs = [None] * len(located)
+                await asyncio.gather(*[
+                    _finish(o, rec_hint=r) for o, r in zip(located, recs)
+                ])
                 located_set = set(located)
                 pending = [o for o in pending if o not in located_set]
             if pending and time.monotonic() >= deadline:
@@ -1945,17 +1968,30 @@ class NodeAgent:
 
     async def _dispatch_local_inner(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         tid = spec.get("task_id", "")
-        # 1. dependencies local
+        # 1. dependencies local — ONE batched ensure (concurrent pulls, one
+        # shared GCS long-poll + one batched holder lookup for the whole
+        # dep set). A shuffle reduce task's N map-partition args land
+        # through the transfer plane in parallel instead of N serial
+        # lookup->pull round trips.
         deps: List[str] = spec.get("deps") or []
         from ray_tpu.exceptions import ObjectStoreFullError
 
-        try:
-            for dep in deps:
-                await self.rpc_ensure_local(dep, timeout_s=config.worker_lease_timeout_s * 10)
-        except (TimeoutError, ObjectStoreFullError) as e:
-            # store-full while pulling deps = transient local pressure, not a
-            # task failure: requeue and let GC/spill free space
-            return {"ok": False, "retryable": True, "reason": "busy", "error": f"deps unavailable: {e}"}
+        if deps:
+            results = await self.rpc_ensure_local_batch(
+                deps, timeout_s=config.worker_lease_timeout_s * 10)
+            failed = [r for r in results if "error" in r]
+            try:
+                # failures re-resolve through the per-object path so hard
+                # errors (lost without lineage, reconstruction budget spent)
+                # surface with their original exception type
+                for r in failed:
+                    await self.rpc_ensure_local(r["object_id"], timeout_s=5.0)
+            except (TimeoutError, ObjectStoreFullError) as e:
+                # store-full/timeout while pulling deps = transient local
+                # pressure, not a task failure: requeue and let GC/spill
+                # free space
+                return {"ok": False, "retryable": True, "reason": "busy",
+                        "error": f"deps unavailable: {e}"}
         self._set_task_state(tid, "deps-ready")
         # 2. resources (PG tasks draw from their committed bundle). Busy is
         # first absorbed by a short LOCAL wait — tasks queue at the node like

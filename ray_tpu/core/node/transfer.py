@@ -117,12 +117,67 @@ class _PullState:
         self.resumed = False
 
 
+class _RegistrationBatcher:
+    """Coalesces GCS registrations of pulled/ingested objects into batched
+    ``register_objects`` RPCs (one per ``transfer_register_batch_ms``
+    window). A shuffle reduce landing its N-block partition set registers
+    the whole set in one control frame instead of N round trips. Callers
+    still await completion — semantics match the per-object RPC exactly,
+    only the framing is shared."""
+
+    def __init__(self, agent) -> None:
+        self.agent = agent
+        self._pending: List[Dict[str, Any]] = []
+        self._waiters: List[asyncio.Future] = []
+        self._wake: Optional[asyncio.Event] = None
+        self._drainer: Optional[asyncio.Task] = None
+        self.batches_sent = 0
+
+    async def register(self, **reg: Any) -> None:
+        fut = asyncio.get_event_loop().create_future()
+        self._pending.append(reg)
+        self._waiters.append(fut)
+        # ONE persistent drainer per agent, started lazily and never exited:
+        # a spawn-per-batch flusher has an orphan window (a registration
+        # landing while the previous batch's GCS call is in flight would
+        # wait for a flush nobody schedules, wedging its pull forever)
+        if self._wake is None:
+            self._wake = asyncio.Event()
+        if self._drainer is None or self._drainer.done():
+            self._drainer = asyncio.ensure_future(self._drain_loop())
+        self._wake.set()
+        await fut
+
+    async def _drain_loop(self) -> None:
+        while True:
+            await self._wake.wait()
+            self._wake.clear()
+            await asyncio.sleep(
+                max(0.0, config.transfer_register_batch_ms / 1000.0))
+            pending, waiters = self._pending, self._waiters
+            self._pending, self._waiters = [], []
+            if not pending:
+                continue
+            self.batches_sent += 1
+            try:
+                await self.agent.gcs.call("register_objects", regs=pending)
+            except BaseException as e:  # noqa: BLE001 - fan the failure out
+                for fut in waiters:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_result(True)
+
+
 class TransferManager:
     def __init__(self, agent) -> None:
         self.agent = agent
         self.budget = _ByteBudget(config.transfer_inflight_max_bytes)
         self._ingests: Dict[str, _Ingest] = {}
         self._progress: Dict[str, _PullState] = {}
+        self._registrar = _RegistrationBatcher(agent)
         self.stats: Dict[str, Any] = {
             "pulls": 0, "pull_bytes": 0, "pull_failovers": 0,
             "pull_retries": 0, "pull_resumes": 0, "stripe_pulls": 0,
@@ -183,9 +238,11 @@ class TransferManager:
             agent.error_objects.add(object_id)
         agent._remember_meta(object_id, owner, contained)
         # the meta rode the first chunk reply, so the pull costs exactly its
-        # data frames — no post-transfer object_info round trip
-        await agent.gcs.call(
-            "register_object", object_id=object_id, size=size,
+        # data frames — no post-transfer object_info round trip; the
+        # registration itself coalesces with sibling pulls into one batched
+        # RPC (partition-set pulls register as a set)
+        await self._registrar.register(
+            object_id=object_id, size=size,
             node_id=agent.hex, owner=owner, contained=contained,
         )
         dt = max(1e-9, time.monotonic() - st.started)
@@ -427,8 +484,8 @@ class TransferManager:
         if ing.is_error:
             agent.error_objects.add(object_id)
         agent._remember_meta(object_id, ing.owner, ing.contained)
-        await agent.gcs.call(
-            "register_object", object_id=object_id, size=ing.total,
+        await self._registrar.register(
+            object_id=object_id, size=ing.total,
             node_id=agent.hex, owner=ing.owner,
             contained=ing.contained or None,
         )
@@ -461,4 +518,5 @@ class TransferManager:
         out["inflight_bytes"] = self.budget.used
         out["open_ingests"] = len(self._ingests)
         out["partial_pulls"] = len(self._progress)
+        out["register_batches"] = self._registrar.batches_sent
         return out

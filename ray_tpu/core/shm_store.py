@@ -323,6 +323,10 @@ class ShmObjectStore:
         self._restore_lock = threading.Lock()
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._used = 0
+        # lifetime spill counters (observability: shuffle stats, node_info)
+        self._spilled_bytes = 0
+        self._spill_count = 0
+        self._restored_bytes = 0
         # aborted reservations may have a zombie writer still holding the
         # offset (crashed-execution recovery): their arena blocks are
         # quarantined for a grace period before re-entering circulation so a
@@ -522,6 +526,9 @@ class ShmObjectStore:
                 "used": self._used,
                 "objects": len(self._entries),
                 "backend": self.backend,
+                "spilled_bytes": self._spilled_bytes,
+                "spill_count": self._spill_count,
+                "restored_bytes": self._restored_bytes,
             }
             if self._arena is not None:
                 out["arena_used"] = self._arena.used()
@@ -606,6 +613,8 @@ class ShmObjectStore:
         self._free_storage_locked(oid, e)
         e.spilled_path = path
         self._used -= e.size
+        self._spilled_bytes += e.size
+        self._spill_count += 1
         logger.debug("spilled %s (%d bytes)", oid.hex()[:16], e.size)
 
     def _restore(self, oid: ObjectID) -> Optional[int]:
@@ -649,6 +658,7 @@ class ShmObjectStore:
                 if e is not None:
                     e.spilled_path = None
                     e.offset = offset
+                    self._restored_bytes += size
                     self._entries.move_to_end(oid)
                 else:
                     self._used -= size  # deleted while restoring

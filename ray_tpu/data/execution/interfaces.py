@@ -66,6 +66,12 @@ class PhysicalOperator:
         self.output_queue: Deque[RefBundle] = deque()
         self.downstream: Optional["PhysicalOperator"] = None
         self.concurrency_cap: Optional[int] = None
+        # participates in the ResourceManager's memory reservation + the
+        # can_submit gate. Default: ops that launch remote tasks. Exchange
+        # ops (AllToAll, shuffle reduce) opt in explicitly even when their
+        # task accounting differs — their materialized outputs must not
+        # bypass the budget every other operator honors.
+        self.budget_participates: Optional[bool] = None
         self._inputs_complete = False
         self._finished = False  # short-circuit (Limit) or fully drained
         self._avg_out_bytes: Optional[float] = None
@@ -87,6 +93,13 @@ class PhysicalOperator:
         return self._inputs_complete and not self.input_queue
 
     # ------------------------------------------------------------ scheduling
+    def in_memory_budget(self) -> bool:
+        """Resolved budget participation (``budget_participates`` wins when
+        set; else: launches remote tasks <=> has a concurrency cap)."""
+        if self.budget_participates is not None:
+            return self.budget_participates
+        return self.concurrency_cap is not None
+
     def can_dispatch(self) -> bool:
         """Work is available to launch right now (ignoring backpressure —
         policies and the ResourceManager gate the actual selection)."""

@@ -270,6 +270,11 @@ class AllToAllOp(PhysicalOperator):
                                                     Iterator[ObjectRef]]):
         super().__init__(name)
         self.bulk_fn = bulk_fn
+        # no concurrency cap (the bulk generator owns its own task fan-out),
+        # but exchange outputs still count against the memory budget — the
+        # barrier exchange must not bypass the accounting that throttles
+        # every other operator
+        self.budget_participates = True
         self._collected: List[ObjectRef] = []
         self._gen: Optional[Iterator[ObjectRef]] = None
         self._gen_done = False

@@ -28,9 +28,12 @@ class ResourceManager:
                                  * config.data_memory_fraction)
         self.cpu_total = cpu_total if cpu_total is not None \
             else self._detect_cpu_total()
-        # only ops that launch remote tasks participate in the reservation;
-        # pass-through ops (Limit, OutputSplit) hold no task memory
-        budgeted = [op for op in operators if op.concurrency_cap is not None] \
+        # only ops that materialize blocks participate in the reservation;
+        # pass-through ops (Limit, OutputSplit) hold no task memory. Exchange
+        # ops (AllToAll, shuffle) opt in via budget_participates even though
+        # their task model differs — their outputs must not bypass the
+        # accounting that backpressures every other operator.
+        budgeted = [op for op in operators if op.in_memory_budget()] \
             or list(operators)
         self._reserved: Dict[int, int] = {
             id(op): self.memory_budget // (2 * len(budgeted)) for op in budgeted

@@ -27,6 +27,9 @@ class OpStats:
         self.last_output_at: Optional[float] = None
         self.queue_peak = 0      # input-queue occupancy high-water mark
         self.in_flight_peak = 0  # concurrent-task high-water mark
+        # operator-specific counters (e.g. a shuffle's exchange bytes,
+        # spill bytes, admission stalls) rendered as a supplementary line
+        self.extra: Dict[str, Any] = {}
 
     def observe_queue(self, depth: int) -> None:
         if depth > self.queue_peak:
@@ -55,7 +58,7 @@ class OpStats:
         return max(0.0, end - self.first_dispatch_at)
 
     def row(self) -> Dict[str, Any]:
-        return {
+        out = {
             "operator": self.name,
             "blocks_in": self.blocks_in,
             "blocks_out": self.blocks_out,
@@ -67,6 +70,9 @@ class OpStats:
             "queue_peak": self.queue_peak,
             "in_flight_peak": self.in_flight_peak,
         }
+        if self.extra:
+            out["extra"] = dict(self.extra)
+        return out
 
 
 def format_stats_table(rows: List[Dict[str, Any]],
@@ -81,4 +87,10 @@ def format_stats_table(rows: List[Dict[str, Any]],
             f"{(r['rows'] if collect_rows else '-'):>8}"
             f"{r['task_s']:>9}{r['wall_s']:>9}"
             f"{r['queue_peak']:>7}{r['in_flight_peak']:>7}")
+        extra = r.get("extra")
+        if extra:
+            detail = ", ".join(
+                f"{k}={round(v, 3) if isinstance(v, float) else v}"
+                for k, v in extra.items())
+            lines.append(f"  └ {detail}")
     return "\n".join(lines)

@@ -153,7 +153,7 @@ class StreamingExecutor:
                 continue
             if not all(p.can_add_input(op) for p in self._policies):
                 continue
-            if op.concurrency_cap is not None and not self._rm.can_submit(op):
+            if op.in_memory_budget() and not self._rm.can_submit(op):
                 continue
             candidates.append(op)
         if not candidates:

@@ -30,6 +30,13 @@ class Stage:
 
     name = "stage"
 
+    def shuffle_spec(self):
+        """The stage's ``ShuffleSpec`` (shuffle.spec) when it is an
+        all-to-all exchange the streaming shuffle subsystem can drive; None
+        compiles to the legacy ``AllToAllOp`` barrier (zip, keyless
+        aggregate, non-exchange stages)."""
+        return None
+
 
 class MapStage(Stage):
     """Row/batch map (task pool, or actor pool when fn_constructor is set).
@@ -95,6 +102,47 @@ def _exchange(inputs: Iterator[ObjectRef], num_outputs: Optional[int],
         yield ref
 
 
+def _exchange_spec(spec, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
+    """Barrier-mode exchange driven by a ``ShuffleSpec`` — the SAME
+    partition functions the streaming ``ShuffleMapOp``/``ShuffleReduceOp``
+    run, so ``RTPU_STREAMING_SHUFFLE=0`` changes scheduling, never data.
+    Collects every input ref up front (the barrier), runs the optional plan
+    phase (boundary samples / row counts), then split + reduce tasks."""
+    input_refs = list(inputs)
+    if not input_refs:
+        return
+    n_out = spec.resolve_partitions(len(input_refs))
+    plan = None
+    if spec.needs_plan:
+        sample_remote = ray_tpu.remote(
+            name=f"data::{spec.name}::sample")(spec.sample_fn)
+        samples = ray_tpu.get(
+            [sample_remote.remote(ref, i) for i, ref in enumerate(input_refs)])
+        plan = spec.plan_fn(samples, n_out)
+
+    map_fn = spec.map_fn
+
+    def split(block, idx, plan_):
+        return map_fn(block, n_out, idx, plan_)
+
+    split_remote = ray_tpu.remote(
+        num_returns=n_out, name=f"data::{spec.name}::map")(split)
+    partitions: List[List[ObjectRef]] = []
+    for i, ref in enumerate(input_refs):
+        out = split_remote.remote(ref, i, plan)
+        partitions.append(list(out) if isinstance(out, (list, tuple)) else [out])
+
+    reduce_remote = ray_tpu.remote(name=f"data::{spec.name}::reduce")(
+        spec.reduce_fn)
+    reduce_refs = [
+        reduce_remote.remote(j, *[parts[j] for parts in partitions])
+        for j in range(n_out)
+    ]
+    for ref in reduce_refs:
+        ray_tpu.wait([ref], num_returns=1, timeout=None)
+        yield ref
+
+
 class RepartitionStage(Stage):
     """Order-preserving repartition (reference: shuffle=False repartition —
     global row order is kept, so zip() after repartition stays aligned)."""
@@ -103,92 +151,34 @@ class RepartitionStage(Stage):
         self.name = f"repartition({num_blocks})"
         self.num_blocks = num_blocks
 
+    def shuffle_spec(self):
+        from ray_tpu.data.shuffle.spec import repartition_spec
+
+        return repartition_spec(self.num_blocks)
+
     def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        input_refs = list(inputs)
-        if not input_refs:
-            return
-        n = self.num_blocks
-
-        @ray_tpu.remote(name="data::repartition_rows")
-        def count_rows(block):
-            return block.num_rows
-
-        counts = ray_tpu.get([count_rows.remote(r) for r in input_refs])
-        total = sum(counts)
-        per, rem = divmod(total, n)
-        # global output boundaries: output j covers rows [out_start[j], out_end[j])
-        out_sizes = [per + (1 if j < rem else 0) for j in range(n)]
-        out_bounds = []
-        acc = 0
-        for s in out_sizes:
-            out_bounds.append((acc, acc + s))
-            acc += s
-        # per-input-block slice plan: block i (global offset g) contributes
-        # its overlap with each output range, preserving order
-        offsets = []
-        g = 0
-        for c in counts:
-            offsets.append(g)
-            g += c
-        plans = []
-        for i, c in enumerate(counts):
-            g0, g1 = offsets[i], offsets[i] + c
-            plan = []
-            for j, (o0, o1) in enumerate(out_bounds):
-                lo, hi = max(g0, o0), min(g1, o1)
-                plan.append((lo - g0, max(lo, hi) - g0) if hi > lo else (0, 0))
-            plans.append(plan)
-
-        def split(block, n_, idx=0):
-            from ray_tpu.data.block import BlockAccessor
-
-            acc_ = BlockAccessor(block)
-            outs = [acc_.slice(s, e) for (s, e) in plans[idx]]
-            return tuple(outs) if n_ > 1 else outs[0]
-
-        def reduce(_j, *parts):
-            from ray_tpu.data.block import concat_blocks
-
-            nonempty = [p for p in parts if p.num_rows]
-            if not nonempty and parts:
-                # an output partition with no rows must still carry the
-                # schema: a column-less block breaks downstream column refs
-                return parts[0].slice(0, 0)
-            return concat_blocks(nonempty)
-
-        yield from _exchange(iter(input_refs), n, split, reduce)
+        yield from _exchange_spec(self.shuffle_spec(), inputs)
 
 
 class ShuffleStage(Stage):
     """Distributed all-to-all random shuffle: rows scatter to random output
     partitions in map tasks, reduce tasks permute within their partition.
-    No driver-side materialization (reference: planner/exchange/)."""
+    No driver-side materialization (reference: planner/exchange/). Map RNGs
+    are derived from the BLOCK INDEX (shuffle.spec.derive_rng), never
+    dispatch order, so a seeded shuffle is deterministic even when maps
+    complete out of order."""
 
     def __init__(self, seed: Optional[int] = None):
         self.name = "random_shuffle"
         self.seed = seed
 
+    def shuffle_spec(self):
+        from ray_tpu.data.shuffle.spec import random_shuffle_spec
+
+        return random_shuffle_spec(self.seed)
+
     def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        seed = self.seed
-
-        def split(block, n, idx=0):
-            import numpy as np
-
-            rng = np.random.default_rng(None if seed is None else seed + idx)
-            assign = rng.integers(0, n, block.num_rows)
-            outs = tuple(block.take(np.nonzero(assign == j)[0]) for j in range(n))
-            return outs if n > 1 else outs[0]
-
-        def reduce(j, *parts):
-            import numpy as np
-
-            from ray_tpu.data.block import concat_blocks
-
-            combined = concat_blocks(list(parts))
-            rng = np.random.default_rng(None if seed is None else seed + 10_000 + j)
-            return combined.take(rng.permutation(combined.num_rows))
-
-        yield from _exchange(inputs, None, split, reduce)
+        yield from _exchange_spec(self.shuffle_spec(), inputs)
 
 
 class SortStage(Stage):
@@ -203,56 +193,13 @@ class SortStage(Stage):
         self.descending = descending
         self.num_blocks = num_blocks
 
+    def shuffle_spec(self):
+        from ray_tpu.data.shuffle.spec import sort_spec
+
+        return sort_spec(self.key, self.descending, self.num_blocks)
+
     def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
-        key, descending = self.key, self.descending
-        input_refs = list(inputs)
-        if not input_refs:
-            return
-        n_out = self.num_blocks or len(input_refs)
-
-        # 1. sample boundary candidates from every block (SortTaskSpec.
-        # sample_boundaries equivalent)
-        @ray_tpu.remote(name="data::sort_sample")
-        def sample(block):
-            import numpy as np
-
-            col = block.column(key).to_numpy(zero_copy_only=False)
-            if len(col) == 0:
-                return np.array([])
-            k = min(64, len(col))
-            idx = np.random.default_rng(0).choice(len(col), size=k, replace=False)
-            return col[idx]
-
-        samples = ray_tpu.get([sample.remote(r) for r in input_refs])
-        import numpy as np
-
-        flat = np.concatenate([s for s in samples if len(s)]) if any(
-            len(s) for s in samples) else np.array([0.0])
-        flat.sort()
-        # n_out-1 boundaries at even quantiles
-        bounds = flat[np.linspace(0, len(flat) - 1, n_out + 1)[1:-1].astype(int)] \
-            if n_out > 1 else np.array([])
-
-        def split(block, n, _idx=0):
-            import numpy as np
-
-            col = block.column(key).to_numpy(zero_copy_only=False)
-            assign = np.searchsorted(bounds, col, side="right")
-            if descending:
-                assign = (n - 1) - assign
-            outs = tuple(block.take(np.nonzero(assign == j)[0]) for j in range(n))
-            return outs if n > 1 else outs[0]
-
-        def reduce(_j, *parts):
-            import pyarrow.compute as pc
-
-            from ray_tpu.data.block import concat_blocks
-
-            combined = concat_blocks(list(parts))
-            order = "descending" if descending else "ascending"
-            return combined.take(pc.sort_indices(combined, sort_keys=[(key, order)]))
-
-        yield from _exchange(iter(input_refs), n_out, split, reduce)
+        yield from _exchange_spec(self.shuffle_spec(), inputs)
 
 
 class AggregateStage(Stage):
@@ -269,36 +216,36 @@ class AggregateStage(Stage):
         self.aggs = aggs
         self.num_blocks = num_blocks
 
+    def shuffle_spec(self):
+        from ray_tpu.data.shuffle.spec import aggregate_spec
+
+        # keyless (global) aggregation returns None: a single-output
+        # barrier combine is already optimal, no exchange to stream
+        return aggregate_spec(self.keys, self.aggs, self.num_blocks)
+
     def execute(self, inputs: Iterator[ObjectRef]) -> Iterator[ObjectRef]:
+        spec = self.shuffle_spec()
+        if spec is not None:
+            yield from _exchange_spec(spec, inputs)
+            return
         keys, aggs = self.keys, self.aggs
         input_refs = list(inputs)
         if not input_refs:
             return
-        n_out = 1 if not keys else (self.num_blocks or min(len(input_refs), 8))
 
         def split(block, n, _idx=0):
-            import numpy as np
-
-            from ray_tpu.data.aggregate import make_partial
-            from ray_tpu.data.block import BlockAccessor  # noqa: F401
-
-            partial = make_partial(block, keys, aggs)
-            if n == 1:
-                return partial
-            assign = _stable_hash_partition(partial, keys, n)
-            return tuple(partial.take(np.nonzero(assign == j)[0]) for j in range(n))
+            return block  # n_out == 1: _exchange skips the split phase
 
         def reduce(_j, *parts):
             from ray_tpu.data.aggregate import make_partial, merge_partials
 
-            # n_out==1 skips the split phase entirely (_exchange fast path):
-            # parts are then RAW blocks — combine them here
+            # parts are RAW blocks (no split phase ran): combine them here
             expected = {c for a in aggs for c, _ in a.merge_aggs()}
             norm = [p if expected.issubset(set(p.column_names))
                     else make_partial(p, keys, aggs) for p in parts]
             return merge_partials(norm, keys, aggs)
 
-        yield from _exchange(iter(input_refs), n_out, split, reduce)
+        yield from _exchange(iter(input_refs), 1, split, reduce)
 
 
 def _stable_hash_partition(table, keys: List[str], n: int):

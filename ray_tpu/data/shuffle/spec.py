@@ -1,0 +1,243 @@
+"""Shuffle exchange specs: the partition functions of one all-to-all.
+
+One ``ShuffleSpec`` fully describes an exchange:
+
+- ``map_fn(block, n_out, block_idx, plan)`` splits one input block into
+  ``n_out`` per-reducer partition blocks (runs in a remote partitioner
+  task);
+- ``reduce_fn(j, *parts)`` combines partition ``j`` of every map output
+  into one output block (runs in a remote reduce task);
+- optional plan phase for exchanges that need global knowledge before
+  partitioning: ``sample_fn(block, block_idx)`` extracts a tiny sample per
+  block (sort boundary candidates, repartition row counts) and
+  ``plan_fn(samples, n_out)`` turns the collected samples into the plan
+  object every map task receives.
+
+The SAME spec drives both the streaming operators (``shuffle.operators``)
+and the legacy ``AllToAllOp`` barrier exchange (``data/executor.py``), so
+flipping ``RTPU_STREAMING_SHUFFLE`` changes scheduling, never data.
+
+Determinism: every RNG here is seeded from the BLOCK INDEX (stable position
+in the upstream stream), never from dispatch/completion order — a seeded
+``random_shuffle`` produces identical rows no matter how maps interleave.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+_MASK64 = (1 << 64) - 1
+
+
+def derive_rng(seed: Optional[int], *stream: int):
+    """Deterministic per-(seed, stream...) generator. ``None`` seed stays
+    nondeterministic. Components are masked to uint64 so negative seeds and
+    large indices feed SeedSequence legally."""
+    import numpy as np
+
+    if seed is None:
+        return np.random.default_rng(None)
+    return np.random.default_rng(
+        np.random.SeedSequence([seed & _MASK64, *[s & _MASK64 for s in stream]])
+    )
+
+
+def _schema_preserving_concat(parts: List[Any]):
+    """Concat partition blocks, keeping the schema when every part is empty
+    (a column-less output block breaks downstream column refs)."""
+    from ray_tpu.data.block import concat_blocks
+
+    nonempty = [p for p in parts if p.num_rows]
+    if not nonempty and parts:
+        return parts[0].slice(0, 0)
+    return concat_blocks(nonempty)
+
+
+class ShuffleSpec:
+    """Partition functions + shape of one exchange. ``num_partitions`` is
+    the stage-pinned reducer count (None = infer from the upstream block
+    count, falling back to ``config.shuffle_default_partitions``)."""
+
+    def __init__(self, name: str,
+                 map_fn: Callable,
+                 reduce_fn: Callable,
+                 num_partitions: Optional[int] = None,
+                 sample_fn: Optional[Callable] = None,
+                 plan_fn: Optional[Callable] = None,
+                 infer_cap: Optional[int] = None):
+        self.name = name
+        self.map_fn = map_fn
+        self.reduce_fn = reduce_fn
+        self.num_partitions = num_partitions
+        self.sample_fn = sample_fn
+        self.plan_fn = plan_fn
+        self.infer_cap = infer_cap
+
+    @property
+    def needs_plan(self) -> bool:
+        return self.plan_fn is not None
+
+    def resolve_partitions(self, upstream_hint: Optional[int]) -> int:
+        from ray_tpu.core.config import config
+
+        if self.num_partitions is not None:
+            return max(1, self.num_partitions)
+        n = upstream_hint or config.shuffle_default_partitions
+        if self.infer_cap is not None:
+            n = min(n, self.infer_cap)
+        return max(1, n)
+
+
+# --------------------------------------------------------------- random_shuffle
+def random_shuffle_spec(seed: Optional[int]) -> ShuffleSpec:
+    """Rows scatter to uniform-random reducers in map tasks; each reduce
+    permutes within its partition. Map RNG streams off the block index
+    (stream tag 0), reduce RNG off the reducer index (stream tag 1)."""
+
+    def map_fn(block, n, idx, _plan=None):
+        import numpy as np
+
+        rng = derive_rng(seed, 0, idx)
+        assign = rng.integers(0, n, block.num_rows)
+        outs = tuple(block.take(np.nonzero(assign == j)[0]) for j in range(n))
+        return outs if n > 1 else outs[0]
+
+    def reduce_fn(j, *parts):
+        combined = _schema_preserving_concat(list(parts))
+        rng = derive_rng(seed, 1, j)
+        if combined.num_rows:
+            combined = combined.take(rng.permutation(combined.num_rows))
+        return combined
+
+    return ShuffleSpec("random_shuffle", map_fn, reduce_fn)
+
+
+# ------------------------------------------------------------------ repartition
+def repartition_spec(num_blocks: int) -> ShuffleSpec:
+    """Order-preserving repartition: the plan phase counts rows per block,
+    computes global output boundaries, and each map slices its block's
+    overlap with every output range."""
+
+    def sample_fn(block, _idx):
+        return block.num_rows
+
+    def plan_fn(counts: List[int], n: int):
+        total = sum(counts)
+        per, rem = divmod(total, n)
+        out_sizes = [per + (1 if j < rem else 0) for j in range(n)]
+        out_bounds = []
+        acc = 0
+        for s in out_sizes:
+            out_bounds.append((acc, acc + s))
+            acc += s
+        plans = []
+        g = 0
+        for c in counts:
+            g0, g1 = g, g + c
+            plan = []
+            for (o0, o1) in out_bounds:
+                lo, hi = max(g0, o0), min(g1, o1)
+                plan.append((lo - g0, max(lo, hi) - g0) if hi > lo else (0, 0))
+            plans.append(plan)
+            g += c
+        return plans
+
+    def map_fn(block, n, idx, plan):
+        from ray_tpu.data.block import BlockAccessor
+
+        acc = BlockAccessor(block)
+        outs = [acc.slice(s, e) for (s, e) in plan[idx]]
+        return tuple(outs) if n > 1 else outs[0]
+
+    def reduce_fn(_j, *parts):
+        return _schema_preserving_concat(list(parts))
+
+    return ShuffleSpec(f"repartition({num_blocks})", map_fn, reduce_fn,
+                       num_partitions=num_blocks,
+                       sample_fn=sample_fn, plan_fn=plan_fn)
+
+
+# ------------------------------------------------------------------------- sort
+def sort_spec(key: str, descending: bool,
+              num_blocks: Optional[int]) -> ShuffleSpec:
+    """Range-partition sort: the plan phase samples boundary candidates per
+    block (overlapping with mapping-side upstream production), maps
+    range-split on the sampled boundaries, reduces sorted-merge."""
+
+    def sample_fn(block, idx):
+        import numpy as np
+
+        col = block.column(key).to_numpy(zero_copy_only=False)
+        if len(col) == 0:
+            return np.array([])
+        k = min(64, len(col))
+        pick = derive_rng(0, 2, idx).choice(len(col), size=k, replace=False)
+        return col[pick]
+
+    def plan_fn(samples, n: int):
+        import numpy as np
+
+        flat = (np.concatenate([s for s in samples if len(s)])
+                if any(len(s) for s in samples) else np.array([0.0]))
+        flat.sort()
+        if n <= 1:
+            return np.array([])
+        return flat[np.linspace(0, len(flat) - 1, n + 1)[1:-1].astype(int)]
+
+    def map_fn(block, n, _idx, bounds):
+        import numpy as np
+
+        col = block.column(key).to_numpy(zero_copy_only=False)
+        assign = np.searchsorted(bounds, col, side="right")
+        if descending:
+            assign = (n - 1) - assign
+        outs = tuple(block.take(np.nonzero(assign == j)[0]) for j in range(n))
+        return outs if n > 1 else outs[0]
+
+    def reduce_fn(_j, *parts):
+        import pyarrow.compute as pc
+
+        combined = _schema_preserving_concat(list(parts))
+        if not combined.num_rows:
+            return combined
+        order = "descending" if descending else "ascending"
+        return combined.take(pc.sort_indices(combined, sort_keys=[(key, order)]))
+
+    return ShuffleSpec(f"sort({key})", map_fn, reduce_fn,
+                       num_partitions=num_blocks,
+                       sample_fn=sample_fn, plan_fn=plan_fn)
+
+
+# -------------------------------------------------------------- groupby + aggs
+def aggregate_spec(keys: List[str], aggs: List[Any],
+                   num_blocks: Optional[int]) -> Optional[ShuffleSpec]:
+    """Hash-partition groupby: maps pre-combine per-group partials and hash-
+    scatter them; reduces merge partials and finalize. Keyless (global)
+    aggregation returns None — a single-output barrier is already optimal."""
+    if not keys:
+        return None
+    names = ",".join(a.name for a in aggs)
+
+    def map_fn(block, n, _idx, _plan=None):
+        import numpy as np
+
+        from ray_tpu.data.aggregate import make_partial
+        from ray_tpu.data.executor import _stable_hash_partition
+
+        partial = make_partial(block, keys, aggs)
+        if n == 1:
+            return partial
+        assign = _stable_hash_partition(partial, keys, n)
+        return tuple(partial.take(np.nonzero(assign == j)[0]) for j in range(n))
+
+    def reduce_fn(_j, *parts):
+        from ray_tpu.data.aggregate import make_partial, merge_partials
+
+        expected = {c for a in aggs for c, _ in a.merge_aggs()}
+        norm = [p if expected.issubset(set(p.column_names))
+                else make_partial(p, keys, aggs) for p in parts]
+        return merge_partials(norm, keys, aggs)
+
+    return ShuffleSpec(f"aggregate({','.join(keys)}:{names})",
+                       map_fn, reduce_fn, num_partitions=num_blocks,
+                       infer_cap=8)

@@ -21,4 +21,28 @@ def format_all_stacks() -> str:
         daemon = t.daemon if t else "?"
         out.append(f"--- {name} (daemon={daemon}) ---")
         out.append("".join(traceback.format_stack(frame)))
+    out.append(format_asyncio_tasks())
+    return "\n".join(out)
+
+
+def format_asyncio_tasks() -> str:
+    """Coroutine stacks of the CURRENT event loop's pending tasks — an
+    async agent parks every coroutine in the selector, so thread dumps
+    alone can't show where an RPC handler or pull is actually waiting."""
+    import asyncio
+
+    try:
+        tasks = asyncio.all_tasks()
+    except RuntimeError:  # no running loop in this thread
+        return ""
+    out = [f"--- asyncio tasks ({len(tasks)} pending) ---"]
+    for task in tasks:
+        try:
+            stack = task.get_stack(limit=12)
+            coro = getattr(task.get_coro(), "__qualname__", str(task))
+            out.append(f"task {coro}:")
+            for fr in stack:
+                out.append("".join(traceback.format_stack(fr, limit=1)))
+        except Exception:  # noqa: BLE001 - best-effort introspection
+            continue
     return "\n".join(out)

@@ -62,6 +62,14 @@ def _watchdog_fire(signum, frame):
     )
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-GB / long-running benches excluded from the tier-1 "
+        "run (-m 'not slow')",
+    )
+
+
 @pytest.hookimpl(wrapper=True)
 def pytest_runtest_protocol(item, nextitem):
     if (

@@ -1,0 +1,253 @@
+"""Streaming distributed shuffle subsystem (ray_tpu/data/shuffle/).
+
+Covers the ISSUE 9 acceptance surface: streaming-vs-barrier A/B equality
+(same ShuffleSpec partition functions drive both), seeded-shuffle
+determinism under out-of-order map completion, empty-partition schema
+preservation, spill-aware reduce admission, an out-of-core sort whose
+working set exceeds the arena, and a chaos run that SIGKILLs a partition
+holder mid-shuffle and finishes through lineage re-execution."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+# ------------------------------------------------------------------ local mode
+@pytest.fixture
+def local(ray_tpu_local):
+    yield
+
+
+def _ids(rows):
+    return [r["id"] for r in rows]
+
+
+def test_streaming_matches_barrier_for_every_exchange(local, monkeypatch):
+    """RTPU_STREAMING_SHUFFLE must change scheduling, never data: sort,
+    seeded shuffle, repartition and groupby produce identical results in
+    both modes (the spec's partition fns are shared)."""
+    def run_all():
+        sort = _ids(rd.range(300, parallelism=6).sort("id", descending=True)
+                    .take_all())
+        shuf = _ids(rd.range(300, parallelism=6).random_shuffle(seed=11)
+                    .take_all())
+        rep = _ids(rd.range(101, parallelism=4).repartition(7).take_all())
+        grp = sorted(
+            (r["id"], r["count()"]) for r in
+            rd.from_items([{"id": i % 5} for i in range(60)])
+            .groupby("id").count().take_all())
+        return sort, shuf, rep, grp
+
+    monkeypatch.setenv("RTPU_STREAMING_SHUFFLE", "1")
+    streaming = run_all()
+    monkeypatch.setenv("RTPU_STREAMING_SHUFFLE", "0")
+    barrier = run_all()
+    assert streaming == barrier
+    assert streaming[0] == sorted(range(300), reverse=True)
+    assert streaming[2] == list(range(101))  # repartition preserves order
+
+
+def test_seeded_shuffle_deterministic_under_out_of_order_maps(local):
+    """Map RNGs derive from the block INDEX (spec.derive_rng), so two runs
+    with identical seeds match even though map tasks complete in different
+    orders across runs (stragglers injected via a jittery upstream map)."""
+    def jitter(b):
+        time.sleep(0.001 * int(b["id"][0]) % 3)
+        return b
+
+    def run():
+        return _ids(rd.range(400, parallelism=8).map_batches(jitter)
+                    .random_shuffle(seed=13).take_all())
+
+    a, b = run(), run()
+    assert a == b
+    assert sorted(a) == list(range(400))
+    assert a != list(range(400))
+
+
+def test_empty_partitions_preserve_schema(local):
+    """More reducers than rows: empty output partitions must still carry
+    the schema (a column-less block breaks downstream column refs)."""
+    out = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]) \
+        .repartition(8).take_all()
+    assert out == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+    schema = rd.from_items([{"a": 1, "b": "x"}]).repartition(4).schema()
+    assert schema is not None and set(schema.names) == {"a", "b"}
+    # sort with empty partitions keeps schema + global order
+    ds = rd.from_items([{"v": 3}, {"v": 1}]).sort("v")
+    assert [r["v"] for r in ds.take_all()] == [1, 3]
+    # shuffle of an empty-ish dataset survives
+    assert rd.range(1, parallelism=1).random_shuffle(seed=0).count() == 1
+
+
+def test_shuffle_stats_surface_in_dataset_stats(local):
+    ds = rd.range(200, parallelism=4).random_shuffle(seed=5)
+    assert ds.count() == 200
+    report = ds.stats()
+    assert "shuffle_map(random_shuffle)" in report
+    assert "shuffle_reduce(random_shuffle)" in report
+    assert "exchange_bytes" in report
+    rows = ds.stats_rows()
+    reduce_row = next(r for r in rows if "shuffle_reduce" in r["operator"])
+    extra = reduce_row["extra"]
+    assert extra["maps"] == 4 and extra["reduces"] == 4
+    assert extra["exchange_bytes"] > 0
+    assert extra["admission_stall_s"] >= 0.0
+
+
+def test_reduce_admission_defers_under_tiny_budget(local, monkeypatch):
+    """An admission budget far below one partition set must DEFER reduces
+    (spill-aware admission) yet still complete via the one-in-flight
+    liveness guarantee."""
+    monkeypatch.setenv("RAY_TPU_SHUFFLE_ADMISSION_MEMORY_FRACTION", "1e-9")
+    ds = rd.range(2000, parallelism=8).random_shuffle(seed=3)
+    assert ds.count() == 2000
+    rows = ds.stats_rows()
+    extra = next(r for r in rows if "shuffle_reduce" in r["operator"])["extra"]
+    assert extra["admission_deferrals"] > 0
+    assert extra["admission_stall_s"] > 0.0
+
+
+def test_exchange_ops_participate_in_memory_budget(local):
+    """Satellite: exchange/reduce outputs no longer bypass the per-op
+    ResourceManager accounting that backpressures every other operator."""
+    from ray_tpu.data.execution.operators import AllToAllOp
+    from ray_tpu.data.execution.planner import build_physical_plan
+    from ray_tpu.data.execution.resource_manager import ResourceManager
+    from ray_tpu.data.shuffle.operators import ShuffleMapOp, ShuffleReduceOp
+
+    ds = rd.range(64, parallelism=4).random_shuffle(seed=1)
+    ops = build_physical_plan(ds._source_fn, ds._stages)
+    assert any(isinstance(op, ShuffleMapOp) for op in ops)
+    reduce_op = next(op for op in ops if isinstance(op, ShuffleReduceOp))
+    rm = ResourceManager(ops, memory_budget_bytes=1 << 20, cpu_total=8)
+    assert id(reduce_op) in rm._reserved  # reserves budget like any task op
+    barrier = AllToAllOp("x", lambda refs: iter(()))
+    assert barrier.in_memory_budget()
+    rm2 = ResourceManager([barrier], memory_budget_bytes=1 << 20, cpu_total=8)
+    assert id(barrier) in rm2._reserved
+
+
+def test_streaming_shuffle_env_fallback_compiles_barrier(local, monkeypatch):
+    from ray_tpu.data.execution.operators import AllToAllOp
+    from ray_tpu.data.execution.planner import build_physical_plan
+
+    ds = rd.range(64, parallelism=4).sort("id")
+    monkeypatch.setenv("RTPU_STREAMING_SHUFFLE", "0")
+    ops = build_physical_plan(ds._source_fn, ds._stages)
+    assert any(isinstance(op, AllToAllOp) for op in ops)
+    monkeypatch.setenv("RTPU_STREAMING_SHUFFLE", "1")
+    ops = build_physical_plan(ds._source_fn, ds._stages)
+    assert not any(isinstance(op, AllToAllOp) for op in ops)
+
+
+# ---------------------------------------------------------------- cluster mode
+@pytest.fixture
+def shuffle_cluster():
+    """Head-only cluster with a deliberately tiny (2 MB) arena: any real
+    shuffle working set exceeds it, exercising spill-aware admission."""
+    from ray_tpu.cluster import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2,
+                                "object_store_memory": 2 * 1024 * 1024})
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_out_of_core_sort_completes_with_spill(shuffle_cluster):
+    """A sort whose working set (~4 MB input + partitions + outputs) far
+    exceeds the 2 MB arena completes through spill-aware admission, emits
+    globally ordered blocks, and actually spilled."""
+    n = 4096
+    ds = rd.range_tensor(n, shape=(128,), parallelism=8)
+
+    def keyed(b):
+        # mix the ids so the sort has real work: descending key
+        return {"k": (n - 1) - b["data"][:, 0], "data": b["data"]}
+
+    sorted_ds = ds.map_batches(keyed).sort("k")
+    prev = -1
+    total = 0
+    for ref in sorted_ds.iter_internal_refs():
+        block = ray_tpu.get(ref, timeout=120)
+        col = block.column("k").to_numpy()
+        if len(col) == 0:
+            continue
+        assert np.all(np.diff(col) >= 0), "block not internally sorted"
+        assert col[0] >= prev, "blocks not globally ordered"
+        prev = int(col[-1])
+        total += len(col)
+    assert total == n
+
+    from ray_tpu.core.rpc import SyncRpcClient
+
+    agent = SyncRpcClient(shuffle_cluster.nodes[0].address)
+    try:
+        usage = agent.call("node_info")["store"]
+        assert usage["spilled_bytes"] > 0, usage  # out-of-core actually spilled
+        assert usage["used"] <= usage["capacity"], usage
+    finally:
+        agent.close()
+
+
+@pytest.fixture
+def chaos_cluster():
+    os.environ["RAY_TPU_HEALTH_CHECK_PERIOD_MS"] = "200"
+    try:
+        from ray_tpu.cluster import Cluster
+
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        ray_tpu.init(address=c.gcs_address)
+        yield c
+        ray_tpu.shutdown()
+        c.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_HEALTH_CHECK_PERIOD_MS", None)
+
+
+def test_kill_partition_holder_mid_shuffle_lineage_recovers(chaos_cluster):
+    """SIGKILL a node holding map partition blocks after the reduce phase
+    has started: surviving reduces must re-materialize their lost inputs
+    through lineage re-execution (split tasks re-run from their retained
+    specs) and the shuffle must deliver every row."""
+    node = chaos_cluster.add_node(num_cpus=2)
+    chaos_cluster.wait_for_nodes(2, timeout=60)
+
+    n = 1200
+    ds = rd.range(n, parallelism=8).random_shuffle(seed=9)
+    it = ds.iter_internal_refs()
+    first = ray_tpu.get(next(it), timeout=120)  # reduce phase has begun
+    seen = first.num_rows
+    ids = list(first.column("id").to_numpy())
+
+    chaos_cluster.remove_node(node)  # SIGKILL: partitions on it are gone
+
+    for ref in it:
+        block = ray_tpu.get(ref, timeout=180)
+        seen += block.num_rows
+        ids.extend(block.column("id").to_numpy())
+    assert seen == n
+    assert sorted(ids) == list(range(n))
+
+
+# ----------------------------------------------------------------- slow bench
+@pytest.mark.slow
+def test_multi_gb_shuffle_smoke(shutdown_only):
+    """Multi-GB-scale shuffle (slow tier only): the bench-sized workload
+    tools/bench_shuffle.py drives, as a correctness smoke."""
+    ray_tpu.init(num_cpus=8)
+    n = 200_000
+    ds = rd.range_tensor(n, shape=(64,), parallelism=16).random_shuffle(seed=1)
+    assert ds.count() == n
