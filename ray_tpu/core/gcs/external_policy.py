@@ -32,6 +32,7 @@ import json
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.config import config
+from ray_tpu.core.rpc import spawn
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("external_policy")
@@ -52,7 +53,7 @@ class ExternalPolicyClient:
     async def start(self) -> None:
         try:
             self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
-            self._read_task = asyncio.ensure_future(self._read_loop())
+            self._read_task = spawn(self._read_loop())
             self._healthy = True
             logger.info("external policy service connected at %s:%d", self.host, self.port)
         except OSError as e:

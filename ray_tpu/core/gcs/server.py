@@ -130,9 +130,9 @@ class GcsServer:
             await self._external.start()
         if self._storage is not None:
             self._restore_snapshot()
-            self._persist_task = asyncio.ensure_future(self._persist_loop())
-        self._health_task = asyncio.ensure_future(self._health_loop())
-        self._gc_task = asyncio.ensure_future(self._gc_loop())
+            self._persist_task = spawn(self._persist_loop())
+        self._health_task = spawn(self._health_loop())
+        self._gc_task = spawn(self._gc_loop())
         self._watchdog_task = spawn(loop_lag_watchdog("gcs"))
         logger.info("GCS listening on %s:%d", host, port)
         return host, port
@@ -812,10 +812,6 @@ class GcsServer:
         await self.rpc.publish(f"actor:{actor_id}", _actor_public(rec))
         return True
 
-    async def rpc_actor_creation_failed(self, actor_id: str, reason: str) -> bool:
-        await self._actor_creation_failed(actor_id, reason, store=False)
-        return True
-
     async def rpc_report_actor_death(self, actor_id: str, reason: str) -> bool:
         await self._on_actor_failure(actor_id, reason)
         return True
@@ -932,12 +928,6 @@ class GcsServer:
         if payload is not None:
             event["payload"] = payload
         return [(h, event) for h in holders]
-
-    async def rpc_remove_object_location(self, object_id: str, node_id: str) -> bool:
-        rec = self.objects.get(object_id)
-        if rec:
-            rec["locations"].discard(node_id)
-        return True
 
     async def rpc_dump_stacks(self) -> str:
         """All thread stacks of THIS process (`ray_tpu stack` backend;
@@ -1096,16 +1086,6 @@ class GcsServer:
         await self._free_everywhere(object_id)
         return True
 
-    async def rpc_free_object(self, object_id: str) -> List[str]:
-        rec = self.objects.pop(object_id, None)
-        self.object_holders.pop(object_id, None)
-        self._pending_free.pop(object_id, None)
-        self.lineage.pop(object_id, None)
-        contained = self.object_contains.pop(object_id, [])
-        if contained:
-            await self.rpc_remove_object_refs(contained, f"obj:{object_id}")
-        return sorted(rec["locations"]) if rec else []
-
     # ------------------------------------------- distributed reference counts
     async def rpc_add_object_refs(self, object_ids: List[str], holder: str) -> bool:
         if holder.startswith("w:"):
@@ -1168,9 +1148,6 @@ class GcsServer:
                 if not holders:
                     self._pending_free[object_id] = now
         return n
-
-    async def rpc_object_ref_counts(self, object_ids: List[str]) -> Dict[str, int]:
-        return {o: len(self.object_holders.get(o, ())) for o in object_ids}
 
     # ------------------------------------------------- streaming generators
     def _stream(self, task_id: str) -> Dict[str, Any]:
@@ -1412,11 +1389,6 @@ class GcsServer:
                     pass
 
     # ------------------------------------------------------------------ lineage
-    async def rpc_put_lineage(self, object_ids: List[str], spec: Dict[str, Any]) -> bool:
-        for object_id in object_ids:
-            self.lineage[object_id] = spec
-        return True
-
     async def rpc_get_lineage(self, object_id: str) -> Optional[Dict[str, Any]]:
         return self.lineage.get(object_id)
 

@@ -236,15 +236,15 @@ class NodeAgent:
             is_head=self.is_head,
         )
         await self.gcs.subscribe("nodes", self._on_node_event)
-        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
-        self._supervise_task = asyncio.ensure_future(self._supervise_loop())
+        self._hb_task = spawn(self._heartbeat_loop())
+        self._supervise_task = spawn(self._supervise_loop())
         if config.log_to_driver:
-            self._log_monitor_task = asyncio.ensure_future(self._log_monitor_loop())
+            self._log_monitor_task = spawn(self._log_monitor_loop())
         if config.memory_monitor_refresh_ms > 0:
-            self._memory_task = asyncio.ensure_future(self._memory_monitor_loop())
-        self._pin_flusher = asyncio.ensure_future(self._pin_flush_loop())
-        self._reg_flusher = asyncio.ensure_future(self._reg_flush_loop())
-        self._unpin_flusher = asyncio.ensure_future(self._unpin_flush_loop())
+            self._memory_task = spawn(self._memory_monitor_loop())
+        self._pin_flusher = spawn(self._pin_flush_loop())
+        self._reg_flusher = spawn(self._reg_flush_loop())
+        self._unpin_flusher = spawn(self._unpin_flush_loop())
         self._watchdog_task = spawn(loop_lag_watchdog("agent"))
         if self.is_head and config.dashboard_port >= 0:
             from ray_tpu.dashboard.head import DashboardHead
@@ -990,7 +990,8 @@ class NodeAgent:
         self.store.abort(ObjectID.from_hex(object_id))
         return True
 
-    async def rpc_store_debug(self, limit: int = 200) -> List[Dict[str, Any]]:
+    # ops endpoint: invoked ad hoc via `ray_tpu` tooling, not by in-tree code
+    async def rpc_store_debug(self, limit: int = 200) -> List[Dict[str, Any]]:  # rtpulint: disable=rpc-drift
         return self.store.debug_entries(limit)
 
     async def rpc_object_sizes(self, object_ids: List[str]) -> List[Optional[int]]:
@@ -1133,6 +1134,9 @@ class NodeAgent:
                 if rec is None:
                     chunk = min(2.0, max(0.05, deadline - time.monotonic()))
                     try:
+                        # per-object pull lock: serializing concurrent pulls
+                        # of ONE object behind this RPC is the point
+                        # rtpulint: disable=race
                         rec = await self.gcs.call(
                             "wait_object_located", object_id=object_id,
                             timeout_s=chunk, timeout=chunk + 5.0,
@@ -1275,7 +1279,9 @@ class NodeAgent:
             )
         lock = self._recon_locks.setdefault(task_key, asyncio.Lock())
         async with lock:
-            # another waiter may have reconstructed while we queued
+            # another waiter may have reconstructed while we queued; the
+            # per-task recon lock exists to serialize exactly these RPCs
+            # rtpulint: disable=race
             rec = await self.gcs.call("lookup_object", object_id=object_id)
             if rec and rec["locations"]:
                 return
@@ -1293,6 +1299,7 @@ class NodeAgent:
             # the dispatch path's ensure_local.
             pinned = (spec.get("deps") or []) + (spec.get("returns") or [])
             try:
+                # rtpulint: disable=race -- same per-task recon lock as above
                 await self.gcs.call(
                     "add_object_refs", object_ids=pinned,
                     holder=self._task_holder(spec),
@@ -2031,6 +2038,9 @@ class NodeAgent:
                     # consumed a wakeup without acquiring (wrong resource
                     # shape): pass it on so the release isn't wasted
                     while self._local_wait_q:
+                        # the wait queue exists to straddle the await: append
+                        # before parking, hand off after waking is the protocol
+                        # rtpulint: disable=race
                         nxt = self._local_wait_q.popleft()
                         if not nxt.done():
                             nxt.set_result(True)

@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ObjectID
-from ray_tpu.core.rpc import RpcConnectionError, RpcError
+from ray_tpu.core.rpc import RpcConnectionError, RpcError, spawn
 from ray_tpu.core.shm_store import ShmWriter
 from ray_tpu.utils.logging import get_logger
 
@@ -144,7 +144,7 @@ class _RegistrationBatcher:
         if self._wake is None:
             self._wake = asyncio.Event()
         if self._drainer is None or self._drainer.done():
-            self._drainer = asyncio.ensure_future(self._drain_loop())
+            self._drainer = spawn(self._drain_loop())
         self._wake.set()
         await fut
 

@@ -218,7 +218,7 @@ RETRY_SAFE_METHODS = frozenset({
     "next_job_id",  # retry burns an id from the sequence — gaps are fine
     "kv_put", "kv_get", "kv_del", "kv_keys",
     "schedule", "lookup_object", "register_object", "register_objects",
-    "pin_tasks", "remove_object_location",
+    "pin_tasks",
     "object_info", "object_sizes", "read_chunk", "free_object_everywhere",
     "delete_local_object", "transfer_stats",
     # idempotent ensure/wait/push surface: a dropped frame must cost one
@@ -231,7 +231,7 @@ RETRY_SAFE_METHODS = frozenset({
     "publish_worker_logs",
     "add_object_refs", "remove_object_refs", "pin_task", "unpin_tasks",
     "drop_holder",
-    "holder_heartbeat", "object_ref_counts", "put_lineage", "get_lineage",
+    "holder_heartbeat", "get_lineage",
     "get_actor", "get_actor_spec", "get_named_actor", "list_named_actors",
     "list_actors", "actor_started", "placement_group_info",
     "placement_group_table", "reserve_bundle", "return_bundle",
@@ -551,7 +551,7 @@ class RpcClient:
         else:
             raise RpcConnectionError(f"cannot connect to {self.host}:{self.port}: {last_err}")
         self._send_lock = asyncio.Lock()
-        self._read_task = asyncio.ensure_future(self._read_loop())
+        self._read_task = spawn(self._read_loop())
         return self
 
     async def _read_loop(self) -> None:
@@ -755,7 +755,7 @@ class RpcClient:
             self._closed = False
             self._conn_gen += 1
             self._send_lock = asyncio.Lock()
-            self._read_task = asyncio.ensure_future(self._read_loop())
+            self._read_task = spawn(self._read_loop())
             for channel in list(self._sub_callbacks):
                 try:
                     await self._call_once("__subscribe__", 2.0, {"channel": channel})

@@ -1,0 +1,38 @@
+"""rtpu-lint: project-specific AST correctness analyzer for the async runtime.
+
+The runtime's worst recent bugs were statically detectable: the PR 6 shuffle
+wedge was an un-retained ``asyncio.ensure_future`` whose task was
+garbage-collected mid-flight, and the control plane dispatches RPCs by string
+name (``call("kv_put", ...)`` -> ``rpc_kv_put``) so a renamed handler fails
+only at runtime under load. This package encodes those bug classes as five
+stdlib-``ast`` passes tuned to this codebase:
+
+- ``rpc-drift``     string ``call("<m>")`` sites with no live ``rpc_<m>``
+                    handler, handlers nothing calls, kwargs absent from the
+                    handler signature
+- ``orphan-task``   ``ensure_future``/``create_task`` results that nothing
+                    retains (the exact PR 6 bug class; use ``rpc.spawn()``)
+- ``loop-blocker``  synchronous sleeps / subprocess / socket / file I/O
+                    lexically inside ``async def`` bodies
+- ``race``          a ``self.`` container mutated both before and after an
+                    ``await`` without a lock; an asyncio lock held across an
+                    ``await`` of a remote ``call()``
+- ``env-flag``      every ``os.environ`` read of an ``RTPU_*`` flag must be
+                    declared in ``core/config.py`` and documented in README.md
+
+Suppressions: ``# rtpulint: disable=<pass>[,<pass>]`` on the offending line
+(or the line directly above); ``# rtpulint: disable-file=<pass>`` anywhere in
+a file. Triaged legacy findings live in ``tools/rtpulint/baseline.json``
+(regenerate with ``--update-baseline``); anything new fails the gate.
+
+Run: ``python -m tools.rtpulint ray_tpu/ [--json]`` — exit 0 only when every
+finding is suppressed or baselined. ``tests/test_lint.py`` runs this over
+``ray_tpu/`` inside tier-1.
+"""
+
+from tools.rtpulint.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    PASS_NAMES,
+    lint_paths,
+)

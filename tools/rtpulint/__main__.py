@@ -1,0 +1,81 @@
+"""CLI: ``python -m tools.rtpulint ray_tpu/ [--json] [--update-baseline]``.
+
+Exit codes: 0 = clean (every finding suppressed or baselined), 1 = new
+unsuppressed findings, 2 = usage error. ``--json`` emits a machine-readable
+report on stdout (for CI annotation); the human format is one
+``path:line: [pass] message`` per finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.rtpulint.core import (PASS_NAMES, default_baseline_path,
+                                 lint_paths, write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rtpulint",
+        description="AST-based correctness analyzer for the rtpu async "
+                    "runtime (RPC drift, orphan tasks, loop blockers, race "
+                    "heuristics, env-flag registry).")
+    ap.add_argument("paths", nargs="*", default=["ray_tpu/"],
+                    help="files/directories to scan (default: ray_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a JSON report on stdout")
+    ap.add_argument("--baseline", default=default_baseline_path(),
+                    help="baseline file of triaged legacy findings "
+                         "(default: tools/rtpulint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show every finding)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write every current unsuppressed finding into the "
+                         "baseline file and exit 0")
+    ap.add_argument("--pass", dest="only_passes", action="append",
+                    choices=PASS_NAMES, metavar="|".join(PASS_NAMES),
+                    help="run only the named pass (repeatable)")
+    ap.add_argument("--no-evidence", action="store_true",
+                    help="do not count call sites in tests/ and tools/ as "
+                         "usage evidence for the unused-handler check")
+    args = ap.parse_args(argv)
+
+    if not args.paths:
+        ap.error("no paths given")
+        return 2
+
+    baseline = None if (args.no_baseline or args.update_baseline) \
+        else args.baseline
+    result = lint_paths(args.paths, baseline_path=baseline,
+                        passes=args.only_passes,
+                        with_evidence=not args.no_evidence)
+
+    if args.update_baseline:
+        write_baseline(args.baseline, result.findings)
+        print(f"baseline: wrote {len(result.findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    if args.as_json:
+        json.dump({
+            "ok": result.ok,
+            "files_scanned": result.files_scanned,
+            "suppressed": result.suppressed,
+            "baselined": result.baselined,
+            "findings": [f.to_dict() for f in result.findings],
+        }, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in result.findings:
+            print(f.render())
+        print(f"rtpu-lint: {result.files_scanned} files, "
+              f"{len(result.findings)} finding(s) "
+              f"({result.suppressed} suppressed, "
+              f"{result.baselined} baselined)")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,289 @@
+"""Analyzer infrastructure: file loading, suppressions, baseline, runner.
+
+Passes are pure functions ``run(fileset, ctx) -> List[Finding]`` over a
+shared parsed view of the tree (one ``ast.parse`` per file). Findings carry a
+*stable key* (path + pass + a pass-chosen identity token, never a line
+number) so the checked-in baseline survives unrelated edits that shift
+lines.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+PASS_NAMES = ("rpc-drift", "orphan-task", "loop-blocker", "race", "env-flag")
+
+# the pass list ends at the first token that is not `name` or `, name`, so
+# trailing prose ("# rtpulint: disable=race -- why it is safe") is ignored
+_PASS_LIST = r"([a-z][a-z\-]*(?:\s*,\s*[a-z][a-z\-]*)*)"
+_SUPPRESS_RE = re.compile(r"#\s*rtpulint:\s*disable=" + _PASS_LIST)
+_SUPPRESS_FILE_RE = re.compile(r"#\s*rtpulint:\s*disable-file=" + _PASS_LIST)
+
+
+@dataclass
+class Finding:
+    path: str          # repo-relative path, "/"-separated
+    line: int
+    pass_name: str
+    message: str
+    key_token: str     # stable identity within (path, pass)
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.pass_name}::{self.key_token}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "pass": self.pass_name,
+            "message": self.message,
+            "key": self.key,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+class ParsedFile:
+    """One source file: text, physical lines, AST, per-line suppressions."""
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.suppressed: Dict[int, Set[str]] = {}
+        self.file_suppressed: Set[str] = set()
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        # tokenize (not regex over lines) so '# rtpulint:' inside string
+        # literals never registers as a suppression
+        import io
+
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_FILE_RE.search(tok.string)
+                if m:
+                    self.file_suppressed |= _parse_pass_list(m.group(1))
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                passes = _parse_pass_list(m.group(1))
+                lineno = tok.start[0]
+                self.suppressed.setdefault(lineno, set()).update(passes)
+                # a standalone comment line suppresses the next line too
+                stripped = self.lines[lineno - 1].strip()
+                if stripped.startswith("#"):
+                    self.suppressed.setdefault(lineno + 1, set()).update(passes)
+        except tokenize.TokenError:
+            pass
+
+    def is_suppressed(self, line: int, pass_name: str) -> bool:
+        if pass_name in self.file_suppressed or "all" in self.file_suppressed:
+            return True
+        marks = self.suppressed.get(line, ())
+        return pass_name in marks or "all" in marks
+
+
+def _parse_pass_list(raw: str) -> Set[str]:
+    return {p.strip() for p in raw.split(",") if p.strip()}
+
+
+@dataclass
+class LintContext:
+    """Shared inputs beyond the scanned tree."""
+
+    repo_root: str
+    # files parsed for *call-site evidence only* (tests/, tools/): a handler
+    # exercised only by the test suite is not dead code, but findings are
+    # never emitted against these files
+    evidence_files: List[ParsedFile] = field(default_factory=list)
+    config_source: str = ""     # text of core/config.py (env-flag registry)
+    readme_source: str = ""     # text of README.md
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]            # unsuppressed, not in baseline
+    suppressed: int
+    baselined: int
+    files_scanned: int
+    all_findings: List[Finding] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", "node_modules")]
+                out.extend(os.path.join(root, f)
+                           for f in files if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def load_files(paths: Iterable[str], repo_root: str) -> List[ParsedFile]:
+    files: List[ParsedFile] = []
+    for abspath in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(abspath), repo_root)
+        try:
+            with open(abspath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            files.append(ParsedFile(abspath, rel, source))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            # unparseable files are someone else's problem (python itself
+            # will complain); the analyzer must not die on them
+            continue
+    return files
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, str]:
+    """baseline.json: {"findings": {key: note}} — note records WHY the
+    finding was triaged as acceptable."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return dict(data.get("findings", {}))
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    existing = load_baseline(path)
+    out: Dict[str, str] = {}
+    for f in sorted(findings, key=lambda f: f.key):
+        out[f.key] = existing.get(f.key, f.message)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"comment": "Triaged legacy rtpu-lint findings. Each key "
+                              "maps to a note explaining why it is accepted. "
+                              "Regenerate with --update-baseline; new code "
+                              "must lint clean instead of growing this file.",
+                   "findings": out}, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def _build_context(scan_files: List[ParsedFile], repo_root: str,
+                   with_evidence: bool) -> LintContext:
+    ctx = LintContext(repo_root=repo_root)
+    scanned = {f.relpath for f in scan_files}
+    if with_evidence:
+        evidence_roots = [os.path.join(repo_root, d) for d in ("tests", "tools")]
+        ctx.evidence_files = [
+            f for f in load_files([p for p in evidence_roots if os.path.isdir(p)],
+                                  repo_root)
+            if f.relpath not in scanned
+        ]
+    for f in scan_files:
+        if f.relpath.endswith("core/config.py"):
+            ctx.config_source = f.source
+            break
+    else:
+        cfg = os.path.join(repo_root, "ray_tpu", "core", "config.py")
+        if os.path.exists(cfg):
+            with open(cfg, "r", encoding="utf-8") as fh:
+                ctx.config_source = fh.read()
+    readme = os.path.join(repo_root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, "r", encoding="utf-8") as fh:
+            ctx.readme_source = fh.read()
+    return ctx
+
+
+def lint_paths(paths: Iterable[str], repo_root: Optional[str] = None,
+               baseline_path: Optional[str] = None,
+               passes: Optional[Iterable[str]] = None,
+               with_evidence: bool = True) -> LintResult:
+    """Run every pass over ``paths``; returns findings with suppressions and
+    the baseline applied. ``passes`` restricts to a subset of PASS_NAMES."""
+    from tools.rtpulint.passes import ALL_PASSES
+
+    repo_root = os.path.abspath(repo_root or os.getcwd())
+    scan_files = load_files(paths, repo_root)
+    ctx = _build_context(scan_files, repo_root, with_evidence)
+    baseline = load_baseline(baseline_path)
+    wanted = set(passes) if passes is not None else set(PASS_NAMES)
+
+    raw: List[Finding] = []
+    for name, run in ALL_PASSES.items():
+        if name in wanted:
+            raw.extend(run(scan_files, ctx))
+    raw.sort(key=lambda f: (f.path, f.line, f.pass_name, f.key_token))
+
+    by_path = {f.relpath: f for f in scan_files}
+    fresh: List[Finding] = []
+    suppressed = baselined = 0
+    for f in raw:
+        pf = by_path.get(f.path)
+        if pf is not None and pf.is_suppressed(f.line, f.pass_name):
+            suppressed += 1
+        elif f.key in baseline:
+            baselined += 1
+        else:
+            fresh.append(f)
+    return LintResult(findings=fresh, suppressed=suppressed,
+                      baselined=baselined, files_scanned=len(scan_files),
+                      all_findings=raw)
+
+
+# ---------------------------------------------------------------- AST helpers
+
+def dotted_name(node: ast.AST) -> str:
+    """'asyncio.ensure_future' for Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("<expr>")
+    return ".".join(reversed(parts))
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_functions(tree: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(qualname, def-node) for every function/method, including nested."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                out.append((qn, child))
+                visit(child, qn + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out

@@ -1,0 +1,15 @@
+"""Pass registry: name -> run(fileset, ctx) -> List[Finding]."""
+
+from tools.rtpulint.passes.rpc_drift import run as rpc_drift
+from tools.rtpulint.passes.orphan_tasks import run as orphan_tasks
+from tools.rtpulint.passes.loop_blockers import run as loop_blockers
+from tools.rtpulint.passes.races import run as races
+from tools.rtpulint.passes.env_flags import run as env_flags
+
+ALL_PASSES = {
+    "rpc-drift": rpc_drift,
+    "orphan-task": orphan_tasks,
+    "loop-blocker": loop_blockers,
+    "race": races,
+    "env-flag": env_flags,
+}

@@ -1,0 +1,105 @@
+"""loop-blocker: synchronous blocking calls lexically inside ``async def``.
+
+One ``time.sleep(0.5)`` on the agent loop stalls heartbeats, RPC dispatch
+and every in-flight transfer on the node; under load the stall gets the node
+declared dead (health_check_failure_threshold) and its tasks re-executed.
+The same applies to synchronous subprocess invocations, blocking socket
+calls, and direct file read/write chains.
+
+Only the *innermost* enclosing function matters: a sync ``def`` nested in an
+``async def`` (e.g. a thread-pool target or callback) legitimately blocks
+its own thread. Thread-hosted loops that intentionally sleep (serve/llm.py's
+decode thread) carry inline suppressions explaining the threading model.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.rtpulint.core import Finding, LintContext, ParsedFile, dotted_name
+
+# dotted-name calls that block the calling thread
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() blocks the event loop; use await asyncio.sleep()",
+    "subprocess.run": "subprocess.run() blocks the event loop; use "
+                      "asyncio.create_subprocess_exec or run_in_executor",
+    "subprocess.call": "subprocess.call() blocks the event loop",
+    "subprocess.check_call": "subprocess.check_call() blocks the event loop",
+    "subprocess.check_output": "subprocess.check_output() blocks the event loop",
+    "os.system": "os.system() blocks the event loop",
+    "socket.create_connection": "synchronous socket connect blocks the event "
+                                "loop; use asyncio.open_connection",
+    "socket.getaddrinfo": "synchronous DNS resolution blocks the event loop; "
+                          "use loop.getaddrinfo",
+    "requests.get": "synchronous HTTP blocks the event loop",
+    "requests.post": "synchronous HTTP blocks the event loop",
+    "requests.request": "synchronous HTTP blocks the event loop",
+}
+
+# blocking socket methods on any receiver: these names are distinctive
+# enough that a method call inside an async body is almost always a raw
+# socket (asyncio streams expose read/readexactly/drain instead)
+_SOCKET_METHODS = {"recv", "recvfrom", "recv_into", "sendall"}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, pf: ParsedFile):
+        self.pf = pf
+        self.findings: List[Finding] = []
+        self.func_stack: List[ast.AST] = []
+        self.qual_stack: List[str] = []
+
+    def _in_async(self) -> bool:
+        return bool(self.func_stack) and isinstance(
+            self.func_stack[-1], ast.AsyncFunctionDef)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.func_stack.append(node)
+        self.qual_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.qual_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.func_stack.append(node)
+        self.qual_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.qual_stack.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_async():
+            name = dotted_name(node.func)
+            why = _BLOCKING_CALLS.get(name)
+            token = name
+            if why is None and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in _SOCKET_METHODS:
+                    why = (f".{attr}() is a blocking socket read/write; use "
+                           f"asyncio streams")
+                    token = attr
+                elif attr in ("read", "write") and isinstance(
+                        node.func.value, ast.Call) and dotted_name(
+                        node.func.value.func) == "open":
+                    why = (f"open(...).{attr}() is synchronous file I/O on "
+                           f"the event loop; use run_in_executor (or accept "
+                           f"it deliberately with a suppression)")
+                    token = f"open.{attr}"
+            if why is not None:
+                qn = ".".join(self.qual_stack)
+                self.findings.append(Finding(
+                    path=self.pf.relpath, line=node.lineno,
+                    pass_name="loop-blocker",
+                    message=f"in async def {qn}: {why}",
+                    key_token=f"{qn}:{token}"))
+        self.generic_visit(node)
+
+
+def run(files: List[ParsedFile], ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for pf in files:
+        v = _Visitor(pf)
+        v.visit(pf.tree)
+        findings.extend(v.findings)
+    return findings

@@ -1,0 +1,91 @@
+"""orphan-task: fire-and-forget tasks nothing retains.
+
+The event loop holds only a WEAK reference to tasks: a bare
+``asyncio.ensure_future(coro())`` statement whose result nobody keeps can be
+garbage-collected mid-execution (the PR 6 shuffle wedge: registration-batch
+flushers vanishing under a 50k-task load). ``ray_tpu.core.rpc.spawn()``
+exists precisely to hold the strong reference — every fire-and-forget must
+route through it.
+
+Flagged: ``asyncio.ensure_future`` / ``asyncio.create_task`` /
+``loop.create_task`` whose result is a bare expression statement or is
+assigned only to ``_`` — i.e. neither awaited, retained in an
+attribute/variable that outlives the statement, passed onward (gather,
+list.append), nor returned. Calls routed through ``spawn()`` are fine by
+construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from tools.rtpulint.core import Finding, LintContext, ParsedFile, dotted_name
+
+_LOOP_NAMES = {"loop", "_loop", "event_loop", "io_loop"}
+
+
+def _is_task_factory(call: ast.Call) -> bool:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    name = dotted_name(fn)
+    if name in ("asyncio.ensure_future", "asyncio.create_task"):
+        return True
+    if fn.attr == "create_task":
+        base = fn.value
+        # loop.create_task / self._loop.create_task
+        if isinstance(base, ast.Name) and base.id in _LOOP_NAMES:
+            return True
+        if isinstance(base, ast.Attribute) and base.attr in _LOOP_NAMES:
+            return True
+        # asyncio.get_event_loop().create_task(...)
+        if isinstance(base, ast.Call) and dotted_name(base.func) in (
+                "asyncio.get_event_loop", "asyncio.get_running_loop"):
+            return True
+    return False
+
+
+def _qualname_of(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    parts: List[str] = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+def run(files: List[ParsedFile], ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for pf in files:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(pf.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call) or not _is_task_factory(node):
+                continue
+            parent = parents.get(node)
+            orphan = False
+            if isinstance(parent, ast.Expr):
+                # bare statement: `asyncio.ensure_future(coro())`
+                orphan = True
+            elif isinstance(parent, ast.Assign):
+                targets = parent.targets
+                orphan = all(isinstance(t, ast.Name) and t.id == "_"
+                             for t in targets)
+            # any other parent (Await, Return, an enclosing Call like
+            # gather()/append(), a container literal, attribute/subscript
+            # assignment, NamedExpr) retains or consumes the task
+            if not orphan:
+                continue
+            qn = _qualname_of(node, parents)
+            findings.append(Finding(
+                path=pf.relpath, line=node.lineno, pass_name="orphan-task",
+                message=f"{dotted_name(node.func)}(...) result is not "
+                        f"retained — the task can be garbage-collected "
+                        f"mid-flight; use ray_tpu.core.rpc.spawn() or keep "
+                        f"the returned task alive",
+                key_token=f"{qn}:{node.lineno}"))
+    return findings

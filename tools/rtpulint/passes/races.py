@@ -1,0 +1,225 @@
+"""race: await-straddling shared-state mutation heuristics.
+
+Every ``await`` is a scheduling point: another task may run and observe (or
+mutate) ``self.`` state mid-update. Two project-tuned heuristics:
+
+1. An ``async def`` that mutates the SAME ``self.``-attributed container
+   (dict/list/set: subscript assign/delete, ``.pop``/``.append``/
+   ``.update``/…) both before and after an ``await``, with neither mutation
+   under an ``async with`` lock. The straddled state can be observed
+   half-updated, and a re-entrant call interleaves its own mutations between
+   the halves. Tuned exclusions: mutations inside ``except``/``finally``
+   (cleanup of the function's own entry is the dominant benign pattern),
+   ``+=``-style subscript increments (stat counters complete synchronously),
+   mutually-exclusive ``if``/``elif`` arms (the scan forks per branch, so a
+   pair never spans two arms that cannot both execute), and
+   ``return``/``raise``-terminated arms (their state never reaches the
+   join).
+
+2. An ``asyncio.Lock`` (any ``async with <...lock...>``) held across an
+   ``await`` of a remote ``call()``/``call_raw()``: a slow or retrying peer
+   serializes every coroutine queued on that lock behind one RPC deadline
+   (multi-second agent stalls; hold locks across local awaits only).
+
+Heuristics, not proofs — triage real-but-accepted cases into the baseline
+or annotate the site with ``# rtpulint: disable=race`` plus a comment
+explaining the invariant that makes it safe.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.rtpulint.core import Finding, LintContext, ParsedFile, dotted_name
+
+_MUTATORS = {
+    "append", "appendleft", "add", "update", "pop", "popitem", "popleft",
+    "remove", "discard", "clear", "extend", "insert", "setdefault",
+}
+
+_REMOTE_CALLS = {"call", "call_async", "call_raw", "call_raw_send",
+                 "call_raw_async", "call_raw_send_async"}
+
+
+def _self_attr_of(node: ast.AST) -> Optional[str]:
+    """'pending' for ``self.pending`` / ``self.pending[...]``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _looks_like_lock(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    if not name and isinstance(expr, ast.Call):
+        name = dotted_name(expr.func)
+    return "lock" in name.lower()
+
+
+class _State:
+    """Path-sensitive-ish scan state: which attrs were mutated before the
+    first await of this path, and after one."""
+
+    __slots__ = ("await_seen", "pre", "post", "dead")
+
+    def __init__(self) -> None:
+        self.await_seen = False
+        self.pre: Dict[str, int] = {}
+        self.post: Dict[str, int] = {}
+        self.dead = False  # path ended (return/raise): nothing downstream
+        #                    of the join can pair with this branch's state
+
+    def fork(self) -> "_State":
+        s = _State()
+        s.await_seen = self.await_seen
+        s.pre = dict(self.pre)
+        s.post = dict(self.post)
+        return s
+
+    def merge(self, *branches: "_State") -> None:
+        live = [b for b in branches if not b.dead]
+        if not live:
+            self.dead = True
+            return
+        for b in live:
+            self.await_seen |= b.await_seen
+            for k, v in b.pre.items():
+                self.pre.setdefault(k, v)
+            for k, v in b.post.items():
+                self.post.setdefault(k, v)
+
+
+class _FuncScan:
+    def __init__(self) -> None:
+        self.state = _State()
+        # attr -> (pre_line, post_line): a straddling pair seen on ONE path
+        self.pairs: Dict[str, Tuple[int, int]] = {}
+        self.lock_call_lines: List[int] = []
+
+    def scan(self, body: List[ast.stmt], locked: bool = False,
+             cleanup: bool = False) -> None:
+        for stmt in body:
+            self._stmt(stmt, locked, cleanup)
+
+    def _stmt(self, node: ast.stmt, locked: bool, cleanup: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes are scanned as their own functions
+        if isinstance(node, (ast.Return, ast.Raise)):
+            for field in ("value", "exc"):
+                sub = getattr(node, field, None)
+                if isinstance(sub, ast.expr):
+                    self._expr(sub, locked, cleanup)
+            self.state.dead = True
+            return
+        if isinstance(node, ast.If):
+            self._expr(node.test, locked, cleanup)
+            then_state, saved = self.state.fork(), self.state
+            self.state = then_state
+            self.scan(node.body, locked, cleanup)
+            else_state = saved.fork()
+            self.state = else_state
+            self.scan(node.orelse, locked, cleanup)
+            self.state = saved
+            self.state.merge(then_state, else_state)
+            return
+        if isinstance(node, ast.AsyncWith):
+            now_locked = locked or any(_looks_like_lock(i.context_expr)
+                                       for i in node.items)
+            if now_locked and not locked:
+                self._find_remote_await(node.body)
+            for item in node.items:
+                self._expr(item.context_expr, locked, cleanup)
+            self.scan(node.body, now_locked, cleanup)
+            return
+        if isinstance(node, ast.Try):
+            self.scan(node.body, locked, cleanup)
+            for h in node.handlers:
+                self.scan(h.body, locked, True)
+            self.scan(node.orelse, locked, cleanup)
+            self.scan(node.finalbody, locked, True)
+            return
+        for field in ("test", "iter", "value", "exc"):
+            sub = getattr(node, field, None)
+            if isinstance(sub, ast.expr):
+                self._expr(sub, locked, cleanup)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._target(t, locked, cleanup)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._target(t, locked, cleanup)
+        # AugAssign on a subscript (self.stats["x"] += 1) is deliberately NOT
+        # a mutation: the read-modify-write completes synchronously
+        for field in ("body", "orelse"):
+            sub = getattr(node, field, None)
+            if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+                self.scan(sub, locked, cleanup)
+
+    def _target(self, t: ast.expr, locked: bool, cleanup: bool) -> None:
+        if isinstance(t, ast.Subscript):
+            attr = _self_attr_of(t)
+            if attr is not None:
+                self._mutation(attr, t.lineno, locked, cleanup)
+        self._expr(t, locked, cleanup)
+
+    def _expr(self, node: ast.expr, locked: bool, cleanup: bool) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Await):
+                self.state.await_seen = True
+            elif isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                          ast.Attribute):
+                if sub.func.attr in _MUTATORS:
+                    attr = _self_attr_of(sub.func.value)
+                    if attr is not None:
+                        self._mutation(attr, sub.lineno, locked, cleanup)
+
+    def _mutation(self, attr: str, line: int, locked: bool,
+                  cleanup: bool) -> None:
+        if locked or cleanup:
+            return
+        st = self.state
+        if st.await_seen:
+            st.post.setdefault(attr, line)
+            if attr in st.pre and attr not in self.pairs:
+                self.pairs[attr] = (st.pre[attr], line)
+        else:
+            st.pre.setdefault(attr, line)
+
+    def _find_remote_await(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Await) and isinstance(sub.value, ast.Call):
+                    fn = sub.value.func
+                    if isinstance(fn, ast.Attribute) and fn.attr in _REMOTE_CALLS:
+                        self.lock_call_lines.append(sub.lineno)
+
+
+def run(files: List[ParsedFile], ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for pf in files:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            scan = _FuncScan()
+            scan.scan(node.body)
+            for attr, (pre_line, post_line) in sorted(scan.pairs.items()):
+                findings.append(Finding(
+                    path=pf.relpath, line=post_line, pass_name="race",
+                    message=f"async def {node.name} mutates self.{attr} both "
+                            f"before (line {pre_line}) and after an await "
+                            f"without holding a lock — another task can "
+                            f"interleave between the halves",
+                    key_token=f"straddle:{node.name}:{attr}"))
+            for line in scan.lock_call_lines:
+                findings.append(Finding(
+                    path=pf.relpath, line=line, pass_name="race",
+                    message=f"async def {node.name} holds an asyncio lock "
+                            f"across an await of a remote call() — a slow "
+                            f"peer serializes every waiter behind one RPC "
+                            f"deadline",
+                    key_token=f"lock-call:{node.name}:{line}"))
+    return findings

@@ -1,0 +1,241 @@
+"""rpc-drift: string-dispatched RPC surface vs. live handlers.
+
+The control plane routes ``client.call("kv_put", ...)`` to the handler
+``rpc_kv_put`` registered via ``RpcServer.register_object`` (rpc.py:295) —
+a renamed handler or a typo'd method string fails only at runtime, under
+load, with a KeyError frame on some other node. This pass cross-references
+the two sides statically:
+
+- handlers: every ``rpc_*`` def in a module that calls
+  ``register_object(...)`` (modules that never register are actor classes
+  whose ``rpc_``-prefixed methods ride the actor plane, not this one), plus
+  every explicit ``register("name", fn)`` / ``register_raw("name", fn)``;
+- call sites: every ``.call("name", ...)`` / ``.call_async`` /
+  ``.call_raw`` / ``.call_raw_send`` (+ ``_async`` variants) with a
+  string-literal method — including both arms of a conditional-expression
+  method (``"a" if x else "b"``) — and string literals flowing through
+  in-tree dispatch wrappers (a def whose parameter is forwarded as the
+  method of an inner ``.call``, e.g. the dashboard's ``_each_agent``);
+- findings: call sites with no matching handler, handlers nothing calls
+  (call sites in tests/ and tools/ count as evidence), and call-site kwargs
+  absent from every matching handler's signature.
+
+The ``timeout`` kwarg is consumed by the RPC client itself and never reaches
+the handler; raw handlers receive an implicit ``payload_len``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+from tools.rtpulint.core import (Finding, LintContext, ParsedFile, const_str,
+                                 dotted_name)
+
+CALL_METHODS = {
+    "call", "call_async",
+    "call_raw", "call_raw_async",
+    "call_raw_send", "call_raw_send_async",
+}
+
+# consumed by RpcClient.call/call_raw before params reach the handler
+CLIENT_KWARGS = {"timeout"}
+
+# RpcServer dispatches these internally (rpc.py _dispatch)
+BUILTIN_HANDLERS = {"__subscribe__": {"channel"}, "__unsubscribe__": {"channel"}}
+
+
+@dataclass
+class Handler:
+    name: str
+    path: str
+    line: int
+    params: Optional[Set[str]]   # None = signature unresolvable
+    has_kwargs: bool = False
+    raw: bool = False
+
+
+@dataclass
+class CallSite:
+    method: str
+    path: str
+    line: int
+    kwargs: List[str]
+    has_star_kwargs: bool
+    via: str  # the client method used ("forward:<fn>" for wrappers)
+
+
+def _params_of(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _method_strings(node: ast.AST) -> List[str]:
+    """String constants a method argument can evaluate to: a literal, or
+    either arm of a conditional expression."""
+    s = const_str(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, ast.IfExp):
+        return _method_strings(node.body) + _method_strings(node.orelse)
+    return []
+
+
+def _collect_handlers(files: List[ParsedFile]) -> List[Handler]:
+    handlers: List[Handler] = []
+    for pf in files:
+        registers_object = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "register_object"
+            for n in ast.walk(pf.tree))
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name.startswith("rpc_") and registers_object:
+                handlers.append(Handler(
+                    name=node.name[4:], path=pf.relpath, line=node.lineno,
+                    params=set(_params_of(node)),
+                    has_kwargs=node.args.kwarg is not None))
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if not isinstance(fn, ast.Attribute) \
+                        or fn.attr not in ("register", "register_raw") \
+                        or len(node.args) < 2:
+                    continue
+                name = const_str(node.args[0])
+                if name is None:
+                    continue  # non-RPC .register() (metrics, faulthandler)
+                target = node.args[1]
+                params: Optional[Set[str]] = None
+                has_kwargs = False
+                tname = ""
+                if isinstance(target, ast.Attribute):
+                    tname = target.attr
+                elif isinstance(target, ast.Name):
+                    tname = target.id
+                tdef = defs.get(tname)
+                if tdef is not None:
+                    params = set(_params_of(tdef))
+                    has_kwargs = tdef.args.kwarg is not None
+                handlers.append(Handler(
+                    name=name, path=pf.relpath, line=node.lineno,
+                    params=params, has_kwargs=has_kwargs,
+                    raw=fn.attr == "register_raw"))
+    return handlers
+
+
+def _collect_forwarders(files: List[ParsedFile]) -> Dict[str, int]:
+    """Defs that forward one of their parameters as the method string of an
+    inner RPC call: {def_name: positional index of that parameter}. A string
+    literal at that position of a call to the def is a real dispatch site
+    the plain scan would miss (dashboard ``_each_agent("metrics_text")``)."""
+    out: Dict[str, int] = {}
+    for pf in files:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = _params_of(node)
+            if not params:
+                continue
+            for call in ast.walk(node):
+                if isinstance(call, ast.Call) \
+                        and isinstance(call.func, ast.Attribute) \
+                        and call.func.attr in CALL_METHODS and call.args \
+                        and isinstance(call.args[0], ast.Name) \
+                        and call.args[0].id in params:
+                    out[node.name] = params.index(call.args[0].id)
+                    break
+    return out
+
+
+def _collect_calls(files: List[ParsedFile],
+                   forwarders: Dict[str, int]) -> List[CallSite]:
+    sites: List[CallSite] = []
+    for pf in files:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if fname in CALL_METHODS and node.args:
+                kwargs = [k.arg for k in node.keywords if k.arg is not None]
+                has_star = any(k.arg is None for k in node.keywords)
+                for method in _method_strings(node.args[0]):
+                    sites.append(CallSite(
+                        method=method, path=pf.relpath, line=node.lineno,
+                        kwargs=kwargs, has_star_kwargs=has_star, via=fname))
+            elif fname in forwarders:
+                idx = forwarders[fname]
+                if idx < len(node.args):
+                    for method in _method_strings(node.args[idx]):
+                        sites.append(CallSite(
+                            method=method, path=pf.relpath, line=node.lineno,
+                            kwargs=[], has_star_kwargs=True,
+                            via=f"forward:{fname}"))
+    return sites
+
+
+def run(files: List[ParsedFile], ctx: LintContext) -> List[Finding]:
+    handlers = _collect_handlers(files)
+    by_name: Dict[str, List[Handler]] = {}
+    for h in handlers:
+        by_name.setdefault(h.name, []).append(h)
+    forwarders = _collect_forwarders(files)
+    sites = _collect_calls(files, forwarders)
+    evidence = _collect_calls(ctx.evidence_files, forwarders) \
+        if ctx.evidence_files else []
+
+    findings: List[Finding] = []
+
+    # 1. call sites with no live handler
+    for s in sites:
+        if s.method in by_name or s.method in BUILTIN_HANDLERS:
+            continue
+        findings.append(Finding(
+            path=s.path, line=s.line, pass_name="rpc-drift",
+            message=f'call("{s.method}") resolves to no rpc_* handler or '
+                    f'register()ed name',
+            key_token=f"call:{s.method}"))
+
+    # 2. handlers nothing calls (tests/tools call sites count as evidence)
+    called: Set[str] = {s.method for s in sites} | {s.method for s in evidence}
+    for h in handlers:
+        if h.name in called:
+            continue
+        findings.append(Finding(
+            path=h.path, line=h.line, pass_name="rpc-drift",
+            message=f'handler "{h.name}" (rpc_{h.name}) has no call site '
+                    f'anywhere in the scanned tree',
+            key_token=f"unused:{h.name}"))
+
+    # 3. kwarg drift: a kwarg no candidate handler accepts
+    for s in sites:
+        cands = by_name.get(s.method)
+        if not cands:
+            continue
+        sigs = [h for h in cands if h.params is not None]
+        if not sigs or any(h.has_kwargs for h in sigs):
+            continue
+        accepted: Set[str] = set()
+        for h in sigs:
+            accepted |= h.params
+            if h.raw:
+                accepted.add("payload_len")
+        for k in s.kwargs:
+            if k in CLIENT_KWARGS or k in accepted:
+                continue
+            findings.append(Finding(
+                path=s.path, line=s.line, pass_name="rpc-drift",
+                message=f'call("{s.method}", {k}=...) passes kwarg "{k}" '
+                        f'absent from every matching handler signature '
+                        f'({", ".join(sorted(h.path for h in sigs))})',
+                key_token=f"kwarg:{s.method}:{k}"))
+    return findings
