@@ -19,7 +19,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu.core import serialization
-from ray_tpu.core.config import config
+from ray_tpu.core.config import columnar_exchange_enabled, config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.resources import (
@@ -174,6 +174,27 @@ class ClusterRuntime(CoreRuntime):
                 logger.warning("sealed-event subscription failed", exc_info=True)
 
     # ------------------------------------------------------------- objects
+    def _store_admission_call(self, method: str, **params):
+        """A store-write RPC against the local agent, retried while the
+        store is TRANSIENTLY full. Dep pinning (agent dispatch) makes a
+        running task's args unevictable and unspillable for the task's
+        whole dispatch, so under pressure every byte of the store can be
+        pinned-or-unsealed for a few seconds at a time; a put landing in
+        that window must wait the pins out (task completion and the
+        busy-requeue path both unpin) instead of failing hard."""
+        deadline = time.monotonic() + config.store_full_put_wait_s
+        delay = 0.05
+        while True:
+            try:
+                return self.agent.call(method, **params)
+            except RpcError as e:
+                if e.remote_type != "ObjectStoreFullError":
+                    raise
+                if time.monotonic() >= deadline:
+                    raise exc.ObjectStoreFullError(str(e)) from None
+            time.sleep(delay)
+            delay = min(delay * 2, 0.5)
+
     def put(self, value: Any) -> ObjectRef:
         w = global_worker()
         oid = w.next_put_id()
@@ -185,7 +206,7 @@ class ClusterRuntime(CoreRuntime):
         self._queue_ref_op("add", oid.hex())  # this process holds the new ref
         if len(payload) <= config.max_direct_call_object_size:
             # small object: one round trip (agent writes the shm segment)
-            self.agent.call(
+            self._store_admission_call(
                 "put_object", object_id=oid.hex(), payload=bytes(payload),
                 contained=[r.id.hex() for r in refs] or None,
             )
@@ -212,8 +233,9 @@ class ClusterRuntime(CoreRuntime):
             self._put_via_rpc(oid, payload,
                               [r.id.hex() for r in refs] or None)
             return ObjectRef(oid)
-        resp = self.agent.call("create_object", object_id=oid.hex(),
-                               size=len(payload))
+        resp = self._store_admission_call("create_object",
+                                          object_id=oid.hex(),
+                                          size=len(payload))
         offset = resp.get("offset") if isinstance(resp, dict) else None
         writer = ShmWriter(oid, len(payload), self.node_hex, offset=offset)
         writer.buffer[:] = payload
@@ -379,8 +401,26 @@ class ClusterRuntime(CoreRuntime):
         else:
             reader = ShmReader(oid, size, self.node_hex, offset=offset)
             try:
-                value = serialization.unpack(reader.read_bytes(),
-                                             zero_copy=True)
+                if (offset is not None and not is_error
+                        and serialization.pinned_reads_active()
+                        and columnar_exchange_enabled()):
+                    # Pinned-args fast path (columnar exchange): the caller
+                    # is a worker resolving task deps the agent holds
+                    # pinned until the task completes, and the object lives
+                    # in the arena (whose mapping is process-wide and never
+                    # unmapped) — decode over the LIVE mapping so arrow
+                    # columns / numpy arrays alias the arena instead of a
+                    # heap copy. Post-decode revalidation catches the
+                    # evicted-and-recycled race exactly like read_bytes().
+                    value = serialization.unpack(
+                        reader.buffer.toreadonly(), zero_copy=True)
+                    if not reader.revalidate():
+                        raise FileNotFoundError(
+                            f"arena slot for {oid.hex()[:16]} recycled "
+                            f"mid-read")
+                else:
+                    value = serialization.unpack(reader.read_bytes(),
+                                                 zero_copy=True)
             finally:
                 reader.close()
         if is_error:

@@ -96,6 +96,10 @@ _DEFINITIONS = [
      "Shared-memory object store arena size per node."),
     ("object_store_full_retries", 10, int,
      "Retries (with eviction attempts) before a put fails with ObjectStoreFullError."),
+    ("store_full_put_wait_s", 30.0, float,
+     "How long a put blocks retrying while the local store is transiently "
+     "full of pinned/unsealed bytes (running tasks' pinned args) before "
+     "raising ObjectStoreFullError."),
     ("arena_abort_quarantine_s", 5.0, float,
      "Grace period before an aborted arena reservation's block is reused "
      "(a zombie writer's late bytes must land in dead memory)."),
@@ -307,6 +311,17 @@ _DEFINITIONS = [
      "Coalescing window for GCS object registrations on the transfer plane "
      "(pulled partition blocks register in one batched RPC per tick, not "
      "one round trip per block)."),
+    # --- data: columnar zero-copy exchange ---
+    ("columnar_exchange_enabled", True, bool,
+     "Columnar exchange path for shuffle blocks: pyarrow Tables serialize "
+     "as Arrow IPC stream bytes carried out-of-band (pickle-5 buffers), so "
+     "readers reconstruct columns as views over the payload — in a worker "
+     "resolving pinned task args, views over the shm arena itself — and "
+     "the shuffle kernels partition/merge via vectorized column ops "
+     "(single argsort scatter, map-side pre-sort + reduce-side k-way "
+     "merge) instead of n-scan takes and full re-sorts. Escape hatch: env "
+     "RTPU_COLUMNAR_EXCHANGE=0 restores the cloudpickle block path and "
+     "the row-object kernels wholesale for A/B."),
 ]
 
 
@@ -343,6 +358,20 @@ def streaming_shuffle_enabled() -> bool:
     if raw is not None:
         return raw.strip().lower() not in ("0", "false", "no", "off")
     return config.streaming_shuffle_enabled
+
+
+def columnar_exchange_enabled() -> bool:
+    """Columnar zero-copy exchange on/off. The RTPU_COLUMNAR_EXCHANGE env
+    var is the operator escape hatch (tools/bench_shuffle.py --columnar=off
+    sets it) and wins over the config entry so one process tree can be
+    flipped wholesale for A/B against the cloudpickle block path. Shuffle
+    specs capture this at DRIVER construction time (the decision bakes into
+    the spec closures shipped to workers), so a mid-run env flip in the
+    driver never splits one exchange across kernel variants."""
+    raw = os.environ.get("RTPU_COLUMNAR_EXCHANGE")
+    if raw is not None:
+        return raw.strip().lower() not in ("0", "false", "no", "off")
+    return config.columnar_exchange_enabled
 
 
 def inline_max_bytes() -> int:

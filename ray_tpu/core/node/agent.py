@@ -157,6 +157,10 @@ class NodeAgent:
         # task_id -> OOM kill message: lets the dispatch path distinguish an
         # intentional memory-monitor kill from a plain worker crash
         self._oom_kills: Dict[str, str] = {}
+        # worker_id -> last-seen absolute Arrow decode counters from run_task
+        # replies (columnar exchange); node_info sums them so the shuffle
+        # coordinator can diff zero-copy vs copied bytes per exchange
+        self._worker_decode: Dict[str, Dict[str, int]] = {}
         # GCS write batching: submit-time pins and seal-time registrations
         # coalesce into one RPC per tick each, taking two GCS round trips off
         # every task's critical path (reference: batched location/ref flushes
@@ -2000,6 +2004,45 @@ class NodeAgent:
                 return {"ok": False, "retryable": True, "reason": "busy",
                         "error": f"deps unavailable: {e}"}
         self._set_task_state(tid, "deps-ready")
+        # Pin deps in the LOCAL store for the rest of dispatch: the worker
+        # reads its args straight out of the shm arena — and under the
+        # columnar exchange keeps column views over the slot for the whole
+        # task body — so LRU spill/eviction must not recycle a dep's slot
+        # while the task can still touch it. (The GCS holder pins taken at
+        # rpc_submit_task guard distributed GC; they say nothing about
+        # local LRU.) pin() on a not-yet-resident entry is a no-op, so
+        # re-ensure and re-pin until the pin actually holds: once an entry
+        # is resident AND pinned it can neither be evicted nor spilled.
+        pinned_deps: List[ObjectID] = []
+        try:
+            for d in dict.fromkeys(deps):
+                oid = ObjectID.from_hex(d)
+                self.store.pin(oid)
+                pinned_deps.append(oid)
+                while not self.store.contains(oid):
+                    # entry vanished before the pin took (evicted while a
+                    # later batch member was still pulling)
+                    self.store.unpin(oid)
+                    pinned_deps.remove(oid)
+                    try:
+                        await self.rpc_ensure_local(d, timeout_s=5.0)
+                    except (TimeoutError, ObjectStoreFullError) as e:
+                        return {"ok": False, "retryable": True,
+                                "reason": "busy",
+                                "error": f"deps unavailable: {e}"}
+                    self.store.pin(oid)
+                    pinned_deps.append(oid)
+            return await self._dispatch_execute(spec, tid)
+        finally:
+            for oid in pinned_deps:
+                self.store.unpin(oid)
+
+    async def _dispatch_execute(self, spec: Dict[str, Any],
+                                tid: str) -> Dict[str, Any]:
+        """Steps 2+3 of local dispatch (resources, worker lease, run, seal);
+        runs with the task's deps pinned in the local store by the caller."""
+        from ray_tpu.exceptions import ObjectStoreFullError
+
         # 2. resources (PG tasks draw from their committed bundle). Busy is
         # first absorbed by a short LOCAL wait — tasks queue at the node like
         # the reference raylet's local task queue — and only then reported
@@ -2079,6 +2122,9 @@ class NodeAgent:
         self._set_task_state(tid, "running")
         try:
             result = await w.client.call("run_task", spec=spec, timeout=None)
+            snap = (result or {}).pop("decode_stats", None)
+            if snap:
+                self._worker_decode[w.worker_id] = snap
             self._set_task_state(tid, "executed")
         except (RpcConnectionError, RpcError) as e:
             if isinstance(e, RpcError):
@@ -2606,6 +2652,12 @@ class NodeAgent:
             "workers": len(self._workers),
             "idle_workers": sum(len(v) for v in self._idle_workers.values()),
             "store": self.store.usage(),
+            # summed last-seen worker decode counters (dead workers keep
+            # their final value so the node total stays monotonic)
+            "decode": {
+                k: sum(v.get(k, 0) for v in self._worker_decode.values())
+                for k in ("zero_copy_bytes", "copied_bytes")
+            },
             # shm-locality probe: a nonce file in THIS machine's /dev/shm.
             # A driver that can read the nonce shares the agent's shm and may
             # use the direct data plane; hostname comparison alone misses

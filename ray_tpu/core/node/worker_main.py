@@ -153,14 +153,20 @@ class WorkerProcess:
 
     def _resolve_args(self, payload: bytes) -> tuple:
         """Unpack (args, kwargs); resolve TOP-LEVEL ObjectRefs to values
-        (nested refs stay refs — reference semantics)."""
+        (nested refs stay refs — reference semantics). Dep objects are
+        pinned by the agent for the whole task execution, so these gets run
+        inside a ``pinned_reads`` window: arena-backed payloads decode over
+        the live shm mapping (columnar-exchange blocks alias the arena)
+        instead of paying a per-arg heap copy."""
         args, kwargs = serialization.unpack(memoryview(payload), zero_copy=False)
         from ray_tpu import api
 
         def resolve(v):
             return api.get(v) if isinstance(v, ObjectRef) else v
 
-        return tuple(resolve(a) for a in args), {k: resolve(v) for k, v in kwargs.items()}
+        with serialization.pinned_reads():
+            return (tuple(resolve(a) for a in args),
+                    {k: resolve(v) for k, v in kwargs.items()})
 
     def _store_value(self, object_id: str, value: Any, is_error: bool = False,
                      collector: Optional[List[Dict[str, Any]]] = None,
@@ -376,7 +382,13 @@ class WorkerProcess:
 
     # ------------------------------------------------------------- task rpc
     async def rpc_run_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
-        return await self._loop.run_in_executor(self._exec_pool, self._execute_task, spec)
+        out = await self._loop.run_in_executor(self._exec_pool, self._execute_task, spec)
+        # absolute process-wide Arrow decode counters ride back on every
+        # reply; the agent keeps the last-seen value per worker (absolute,
+        # not deltas: concurrent tasks in this pool share the counter, so
+        # per-task windows would double-count overlapping decodes)
+        out["decode_stats"] = serialization.arrow_decode_snapshot()
+        return out
 
 
     def _flush_profile_spans(self) -> None:

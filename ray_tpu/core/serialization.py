@@ -34,6 +34,42 @@ _custom_lock = threading.Lock()
 # Thread-local capture of ObjectRefs encountered while pickling a value.
 _capture = threading.local()
 
+# Thread-local marker for reads whose source buffer is PINNED for the
+# caller's lifetime (a worker resolving task args: the agent holds the deps
+# pinned until the task completes). Inside this window an arena-backed read
+# may decode directly over the live shm mapping — columns/arrays alias the
+# arena instead of a heap copy. Outside it (driver gets, ad-hoc gets inside
+# task bodies), nothing guarantees the slot isn't evicted+recycled later,
+# so readers must copy (PR 3's read_chunk_raw copy-under-pressure rule).
+_pinned_reads = threading.local()
+
+# Process-local decode accounting for the columnar exchange: bytes of Arrow
+# columns reconstructed as views over the IPC payload (zero-copy) vs bytes
+# of columns whose layout forces a copy/decode on access (pyobj and other
+# non-fixed-width fallbacks). Sampled by ShuffleCoordinator baseline/diff.
+arrow_decode_stats: Dict[str, int] = {"zero_copy_bytes": 0, "copied_bytes": 0}
+
+
+class pinned_reads:
+    """``with serialization.pinned_reads():`` — marks the current thread as
+    holding pins over every object it reads (nestable)."""
+
+    def __enter__(self):
+        _pinned_reads.depth = getattr(_pinned_reads, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _pinned_reads.depth = getattr(_pinned_reads, "depth", 1) - 1
+        return False
+
+
+def pinned_reads_active() -> bool:
+    return getattr(_pinned_reads, "depth", 0) > 0
+
+
+def arrow_decode_snapshot() -> Dict[str, int]:
+    return dict(arrow_decode_stats)
+
 
 def register_serializer(cls: type, *, serializer: Callable, deserializer: Callable) -> None:
     """Register a custom reducer for ``cls`` (like ray.util.register_serializer)."""
@@ -63,6 +99,8 @@ class _Pickler(cloudpickle.Pickler):
             import numpy as np
 
             return (_reconstruct_jax, (np.asarray(obj), obj.dtype.name))
+        if _is_arrow_table(obj):
+            _sync_arrow_serializer()
         with _custom_lock:
             entry = _custom_serializers.get(type(obj))
         if entry is not None:
@@ -94,6 +132,67 @@ def _reconstruct_jax(np_value: Any, dtype_name: str) -> Any:
 def _is_jax_array(obj: Any) -> bool:
     mod = type(obj).__module__
     return mod.startswith("jax") and type(obj).__name__ in ("ArrayImpl", "Array")
+
+
+# ---------------------------------------------------------------------------
+# Columnar exchange: pa.Table <-> Arrow IPC stream bytes, out-of-band.
+#
+# A Table's default pickle materializes every column through in-band bytes
+# (decode = full copy). Under RTPU_COLUMNAR_EXCHANGE the Table instead
+# reduces to ONE Arrow IPC stream buffer wrapped in a PickleBuffer, which
+# serialize()'s buffer_callback extracts out-of-band into the object
+# payload (64-byte aligned). unpack(zero_copy=True) hands the deserializer
+# a memoryview over the stored payload, and ``pa.ipc.open_stream`` over it
+# is zero-copy for fixed-width layouts — the reconstructed columns are
+# views of the payload (the shm arena itself on the pinned worker-arg
+# path). Registered lazily through register_serializer on the first Table
+# pickled, so importing this module never imports pyarrow.
+# ---------------------------------------------------------------------------
+def _is_arrow_table(obj: Any) -> bool:
+    mod = type(obj).__module__
+    return mod.split(".")[0] == "pyarrow" and type(obj).__name__ == "Table"
+
+
+def _table_to_ipc(table: Any) -> "pickle.PickleBuffer":
+    import pyarrow as pa
+
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return pickle.PickleBuffer(sink.getvalue())
+
+
+def _ipc_to_table(buf: Any) -> Any:
+    import pyarrow as pa
+
+    # registers the ray_tpu.pyobj extension type before the schema is
+    # parsed (an unknown extension would decay to its storage type)
+    from ray_tpu.data.block import classify_table_bytes
+
+    table = pa.ipc.open_stream(pa.py_buffer(buf)).read_all()
+    fast, fallback = classify_table_bytes(table)
+    arrow_decode_stats["zero_copy_bytes"] += fast
+    arrow_decode_stats["copied_bytes"] += fallback
+    return table
+
+
+def _sync_arrow_serializer() -> None:
+    """Keep the pa.Table registry entry in step with the columnar flag:
+    register the IPC serializer when enabled, drop it when disabled (so the
+    Table falls back to its default pickle for A/B). Never clobbers a
+    user-registered Table serializer."""
+    import pyarrow as pa
+
+    from ray_tpu.core.config import columnar_exchange_enabled
+
+    with _custom_lock:
+        entry = _custom_serializers.get(pa.Table)
+        ours = entry is not None and entry[0] is _table_to_ipc
+        if columnar_exchange_enabled():
+            if entry is None:
+                _custom_serializers[pa.Table] = (_table_to_ipc, _ipc_to_table)
+        elif ours:
+            del _custom_serializers[pa.Table]
 
 
 def serialize(value: Any) -> Tuple[bytes, List["pickle.PickleBuffer"], List[Any]]:

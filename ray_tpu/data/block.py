@@ -201,7 +201,61 @@ def _column_to_numpy(col) -> np.ndarray:
     return col.to_numpy(zero_copy_only=False)
 
 
-def concat_blocks(blocks: List[Block]) -> Block:
+def concat_blocks(blocks: List[Block],
+                  schema: Optional[pa.Schema] = None) -> Block:
+    """Concat, keeping a usable schema for the empty case: a schema-less
+    ``pa.table({})`` breaks downstream schema checks (iter_batches column
+    refs, zip alignment), so callers that know the exchange's schema thread
+    it through here."""
     if not blocks:
-        return pa.table({})
+        return schema.empty_table() if schema is not None else pa.table({})
     return pa.concat_tables(blocks)
+
+
+# ------------------------------------------------------------- block formats
+# Column-format classification for the columnar exchange: "fast" layouts
+# (fixed-width primitives, FixedShapeTensor, fixed-size lists) reconstruct
+# from IPC bytes as zero-copy views and have vectorized partition/sort
+# kernels; everything else (pyobj extension, variable-width strings/binary,
+# nested types) takes the row-object fallback and pays a copy/decode.
+def is_fast_format(t: pa.DataType) -> bool:
+    if isinstance(t, _PyObjType):
+        return False
+    if isinstance(t, pa.FixedShapeTensorType) or pa.types.is_fixed_size_list(t):
+        return True
+    return (pa.types.is_integer(t) or pa.types.is_floating(t)
+            or pa.types.is_boolean(t) or pa.types.is_temporal(t)
+            or pa.types.is_decimal(t))
+
+
+def classify_table_bytes(table: Block) -> tuple:
+    """(fast_bytes, fallback_bytes) over the table's columns — the split
+    the exchange stats report as zero-copy vs copied bytes."""
+    fast = fallback = 0
+    for col in table.columns:
+        if is_fast_format(col.type):
+            fast += col.nbytes
+        else:
+            fallback += col.nbytes
+    return fast, fallback
+
+
+def sort_key_array(block: Block, key: str) -> Optional[np.ndarray]:
+    """The key column as a numpy array the vectorized sort kernels can
+    order with plain comparisons, or None when the column must take the
+    pyarrow fallback: non-fast layout, nulls (to_numpy would widen to
+    NaN), or float NaNs (comparison-based merge would misplace them
+    relative to pc.sort_indices' nulls-last ordering)."""
+    col = block.column(key)
+    if not is_fast_format(col.type) or isinstance(
+            col.type, pa.FixedShapeTensorType) or pa.types.is_fixed_size_list(
+            col.type):
+        return None
+    if col.null_count:
+        return None
+    arr = col.to_numpy(zero_copy_only=False)
+    if arr.dtype == object:
+        return None
+    if np.issubdtype(arr.dtype, np.floating) and np.isnan(arr).any():
+        return None
+    return arr

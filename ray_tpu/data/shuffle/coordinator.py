@@ -49,6 +49,12 @@ class ShuffleCoordinator:
             "maps": 0, "reduces": 0, "partitions": n_out,
             "exchange_bytes": 0, "admission_stall_s": 0.0,
             "admission_deferrals": 0, "spill_bytes": 0, "stripe_pulls": 0,
+            # columnar exchange decode accounting: bytes of Arrow columns
+            # reconstructed as zero-copy views over exchange payloads vs
+            # bytes that took the copy/decode fallback (pyobj et al).
+            # Cluster-wide worker counters + this driver process; local
+            # mode records 0 (LocalRuntime never serializes blocks).
+            "zero_copy_bytes": 0, "copied_bytes": 0,
         }
         self._baseline_metrics: Optional[Dict[str, int]] = None
 
@@ -130,7 +136,15 @@ class ShuffleCoordinator:
     def _cluster_metrics() -> Dict[str, int]:
         """Best-effort cluster-wide spill/stripe counters (zeros when the
         runtime has no agents — local mode — or any RPC fails)."""
-        out = {"spill_bytes": 0, "stripe_pulls": 0}
+        out = {"spill_bytes": 0, "stripe_pulls": 0,
+               "zero_copy_bytes": 0, "copied_bytes": 0}
+        # the driver process decodes too (direct-data-plane gets of partition
+        # blocks land here): fold its own counters into the cluster total
+        from ray_tpu.core import serialization
+
+        snap = serialization.arrow_decode_snapshot()
+        out["zero_copy_bytes"] += snap["zero_copy_bytes"]
+        out["copied_bytes"] += snap["copied_bytes"]
         try:
             from ray_tpu import api as _api
 
@@ -143,8 +157,12 @@ class ShuffleCoordinator:
                     continue
                 try:
                     client = runtime._agent_client(info["NodeManagerAddress"])
-                    usage = client.call("node_info", timeout=5.0)["store"]
+                    ninfo = client.call("node_info", timeout=5.0)
+                    usage = ninfo["store"]
                     out["spill_bytes"] += int(usage.get("spilled_bytes", 0))
+                    decode = ninfo.get("decode") or {}
+                    out["zero_copy_bytes"] += int(decode.get("zero_copy_bytes", 0))
+                    out["copied_bytes"] += int(decode.get("copied_bytes", 0))
                     tstats = client.call("transfer_stats", timeout=5.0)
                     out["stripe_pulls"] += int(tstats.get("stripe_pulls", 0))
                 except Exception:  # noqa: BLE001 - dead node mid-scan
@@ -165,4 +183,8 @@ class ShuffleCoordinator:
             0, now["spill_bytes"] - base["spill_bytes"])
         self.stats["stripe_pulls"] = max(
             0, now["stripe_pulls"] - base["stripe_pulls"])
+        self.stats["zero_copy_bytes"] = max(
+            0, now["zero_copy_bytes"] - base["zero_copy_bytes"])
+        self.stats["copied_bytes"] = max(
+            0, now["copied_bytes"] - base["copied_bytes"])
         self._baseline_metrics = None

@@ -251,3 +251,216 @@ def test_multi_gb_shuffle_smoke(shutdown_only):
     n = 200_000
     ds = rd.range_tensor(n, shape=(64,), parallelism=16).random_shuffle(seed=1)
     assert ds.count() == n
+
+
+# ----------------------------------------------------- columnar exchange (17)
+def _exchange_results():
+    sort = _ids(rd.range(300, parallelism=6).sort("id", descending=True)
+                .take_all())
+    shuf = _ids(rd.range(300, parallelism=6).random_shuffle(seed=11)
+                .take_all())
+    rep = _ids(rd.range(101, parallelism=4).repartition(7).take_all())
+    grp = sorted(
+        (r["id"], r["count()"]) for r in
+        rd.from_items([{"id": i % 5} for i in range(60)])
+        .groupby("id").count().take_all())
+    return sort, shuf, rep, grp
+
+
+def test_columnar_exchange_ab_identical_all_exchanges(local, monkeypatch):
+    """RTPU_COLUMNAR_EXCHANGE flips the partition/merge kernels (argsort
+    scatter + map pre-sort/k-way merge vs n-scan takes + full re-sort) but
+    may never change results: all four exchanges are byte-identical in both
+    columnar modes and both exchange modes."""
+    out = {}
+    for columnar in ("1", "0"):
+        monkeypatch.setenv("RTPU_COLUMNAR_EXCHANGE", columnar)
+        for streaming in ("1", "0"):
+            monkeypatch.setenv("RTPU_STREAMING_SHUFFLE", streaming)
+            out[(columnar, streaming)] = _exchange_results()
+    assert len(set(map(repr, out.values()))) == 1
+    assert out[("1", "1")][0] == sorted(range(300), reverse=True)
+
+
+def test_sort_skew_bounded_under_duplicate_keys(local, monkeypatch):
+    """Regression for range-sort skew: with 90% of rows sharing one key,
+    boundary dedupe + round-robin tie spreading must keep every reducer
+    partition well below the naive all-ties-in-one-reducer 90%."""
+    rng = np.random.default_rng(0)
+    n = 4000
+    keys = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 100, n))
+    rows = [{"k": int(k), "i": i} for i, k in enumerate(keys)]
+    for columnar in ("1", "0"):
+        monkeypatch.setenv("RTPU_COLUMNAR_EXCHANGE", columnar)
+        ds = rd.from_items(rows, parallelism=8).sort("k")
+        sizes, ks = [], []
+        for ref in ds.iter_internal_refs():
+            block = ray_tpu.get(ref)
+            sizes.append(block.num_rows)
+            ks.extend(block.column("k").to_numpy())
+        assert sum(sizes) == n
+        assert ks == sorted(ks)
+        assert max(sizes) < 0.5 * n, (columnar, sizes)
+
+
+def test_concat_blocks_empty_keeps_schema():
+    import pyarrow as pa
+
+    from ray_tpu.data.block import concat_blocks
+    from ray_tpu.data.shuffle.spec import _schema_preserving_concat
+
+    schema = pa.schema([("a", pa.int64()), ("b", pa.string())])
+    empty = concat_blocks([], schema=schema)
+    assert empty.num_rows == 0 and empty.schema.equals(schema)
+    assert concat_blocks([]).num_rows == 0  # schema-less still works
+    # reduce-side: all-empty partition list keeps the spec's schema
+    out = _schema_preserving_concat([], schema=schema)
+    assert out.schema.equals(schema)
+    # and an empty part next to a real one doesn't poison the concat
+    real = pa.table({"a": [1], "b": ["x"]})
+    out = _schema_preserving_concat([pa.table({}), real])
+    assert out.num_rows == 1 and out.schema.equals(schema)
+
+
+def test_iter_batches_through_empty_partitions(local, monkeypatch):
+    """dataset._batch_iterator carries a remainder block between output
+    partitions; empty exchange partitions (8 reducers, 3 rows) must not
+    break the carry concat with a schema-less block."""
+    for columnar in ("1", "0"):
+        monkeypatch.setenv("RTPU_COLUMNAR_EXCHANGE", columnar)
+        ds = rd.from_items([{"a": 1}, {"a": 2}, {"a": 3}]).repartition(8)
+        batches = list(ds.iter_batches(batch_size=2, batch_format="numpy"))
+        got = sorted(int(v) for b in batches for v in b["a"])
+        assert got == [1, 2, 3]
+
+
+def test_mixed_tensor_pyobj_block_through_columnar_sort(local, monkeypatch):
+    """Blocks mixing a fast (tensor) column with a pyobj column take the
+    vectorized scatter but fall back off the comparison merge only when the
+    KEY itself isn't fast — here the key is fast, the payload is not, and
+    both must survive the exchange intact."""
+    monkeypatch.setenv("RTPU_COLUMNAR_EXCHANGE", "1")
+
+    class Tag:
+        def __init__(self, v):
+            self.v = v
+
+    rows = [{"k": (97 * i) % 50, "vec": np.arange(4) + i, "obj": Tag(i)}
+            for i in range(120)]
+    out = rd.from_items(rows, parallelism=5).sort("k").take_all()
+    assert [r["k"] for r in out] == sorted(r["k"] for r in rows)
+    for r in out:
+        assert isinstance(r["obj"], Tag)
+        assert r["vec"][0] == r["obj"].v
+    # pyobj SORT KEY: comparison kernels must bail to pc.sort_indices
+    str_rows = [{"k": f"key-{i % 7}", "i": i} for i in range(40)]
+    got = [r["k"] for r in rd.from_items(str_rows, parallelism=3)
+           .sort("k").take_all()]
+    assert got == sorted(r["k"] for r in str_rows)
+
+
+def test_table_ipc_serializer_roundtrip(monkeypatch):
+    """Unit: under the flag a pa.Table pickles as ONE out-of-band IPC
+    buffer; decode over the payload is zero-copy for fast columns (buffer
+    addresses alias the payload) and the decode stats split fast vs
+    fallback bytes. Flag off falls back to the default Table pickle."""
+    import pyarrow as pa
+
+    from ray_tpu.core import serialization as ser
+    from ray_tpu.data.block import block_from_rows
+
+    monkeypatch.setenv("RTPU_COLUMNAR_EXCHANGE", "1")
+    t = pa.table({"k": np.arange(256, dtype=np.int64)})
+    payload, _refs = ser.pack(t)
+    before = ser.arrow_decode_snapshot()
+    out = ser.unpack(memoryview(payload), zero_copy=True)
+    assert out.equals(t)
+    buf = out.column("k").chunk(0).buffers()[1]
+    pb = pa.py_buffer(payload)
+    assert pb.address <= buf.address < pb.address + pb.size
+    after = ser.arrow_decode_snapshot()
+    assert after["zero_copy_bytes"] - before["zero_copy_bytes"] == 256 * 8
+    # pyobj columns decode but count as copied bytes
+    t2 = block_from_rows([{"o": object()} for _ in range(3)],
+                         object_columns={"o"})
+    p2, _ = ser.pack(t2)
+    before = ser.arrow_decode_snapshot()
+    out2 = ser.unpack(memoryview(p2), zero_copy=True)
+    assert out2.schema.equals(t2.schema) and out2.num_rows == 3
+    assert ser.arrow_decode_snapshot()["copied_bytes"] > before["copied_bytes"]
+    # flag off: default pickle path round-trips too (A/B hatch)
+    monkeypatch.setenv("RTPU_COLUMNAR_EXCHANGE", "0")
+    p3, _ = ser.pack(t)
+    assert ser.unpack(memoryview(p3), zero_copy=True).equals(t)
+
+
+def test_bench_shuffle_smoke_asserts_equality(shutdown_only):
+    """tools/bench_shuffle.py --smoke runs both columnar settings and
+    asserts every (streaming, columnar) combo emits identical output
+    sequences — wired into tier-1 so the A/B harness itself stays green."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("RTPU_COLUMNAR_EXCHANGE", None)
+    p = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "bench_shuffle.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert p.returncode == 0, p.stdout + p.stderr
+    lines = [_json.loads(l) for l in p.stdout.splitlines()
+             if l.startswith("{")]
+    assert any(l.get("result_equality") == "ok" for l in lines)
+    metrics = {l["metric"] for l in lines if "metric" in l}
+    assert "shuffle_sort_streaming_gbps_per_node" in metrics
+    assert "shuffle_sort_streaming_legacy_gbps_per_node" in metrics
+
+
+def test_worker_arg_table_aliases_arena(monkeypatch):
+    """Cluster: a task's pa.Table argument decodes as views over the shm
+    ARENA itself (not a heap copy) — the pinned-args zero-copy path. Only
+    ObjectRef args ride the object plane (plain args travel in-band in the
+    task spec), so the table is put() first — exactly how shuffle blocks
+    travel. The assertion compares the column buffer address against the
+    worker's own arena mapping; skipped on the segments backend (no stable
+    mapping)."""
+    import ctypes
+
+    from ray_tpu.cluster import Cluster
+
+    monkeypatch.setenv("RTPU_COLUMNAR_EXCHANGE", "1")
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        import pyarrow as pa
+
+        @ray_tpu.remote
+        def probe(t):
+            import ctypes as _ct
+
+            from ray_tpu import api as _api
+            from ray_tpu.core.shm_store import attach_arena
+
+            addr = t.column("k").chunk(0).buffers()[1].address
+            node_hex = _api.global_worker().runtime.node_hex
+            try:
+                arena = attach_arena(node_hex)
+            except (FileNotFoundError, OSError):
+                return {"backend": "segments"}
+            base = _ct.addressof(arena._buf)
+            return {"backend": "arena", "sum": int(t.column("k").to_numpy().sum()),
+                    "aliased": base <= addr < base + arena.capacity}
+
+        table = pa.table({"k": np.arange(50_000, dtype=np.int64)})
+        out = ray_tpu.get(probe.remote(ray_tpu.put(table)), timeout=60)
+        if out["backend"] == "segments":
+            pytest.skip("arena backend unavailable (segments fallback)")
+        assert out["aliased"] is True
+        assert out["sum"] == int(np.arange(50_000, dtype=np.int64).sum())
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

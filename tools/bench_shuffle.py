@@ -15,11 +15,20 @@ exchange. The mode is a DRIVER-side planning decision
 same cluster — identical workers, identical data plane; deltas are
 attributable to exchange scheduling alone.
 
+A second A/B axis, ``--columnar {on,off,both}``, flips the columnar
+zero-copy exchange (``RTPU_COLUMNAR_EXCHANGE``). Unlike the streaming flag
+this is NOT a pure driver-side planning decision — workers capture it at
+spawn for their encode path — so each columnar setting gets a FRESH runtime
+(env set before init). Metrics from the legacy (off) side carry a
+``_legacy`` suffix. ``--smoke`` additionally asserts that every
+(streaming, columnar) combination produces identical output sequences.
+
 Prints one JSON line per metric; --out writes the artifact (round/host/
 method + per-mode GB/s, matching the RAYPERF artifact house style).
 """
 
 import argparse
+import hashlib
 import json
 import os
 import sys
@@ -38,8 +47,13 @@ def _dataset(rows: int, row_bytes: int, parallelism: int):
 
 def run_one(op: str, rows: int, row_bytes: int, parallelism: int,
             nodes: int, streaming: bool):
-    """One timed exchange; returns (gbps_per_node, seconds, bytes)."""
+    """One timed exchange; returns (gbps_per_node, seconds, bytes, digest).
+    ``digest`` fingerprints the output SEQUENCE (first column of every
+    block, in stream order) so A/B combos can assert result equality."""
+    import numpy as np
+
     import ray_tpu
+    from ray_tpu.data.block import _column_to_numpy
 
     os.environ["RTPU_STREAMING_SHUFFLE"] = "1" if streaming else "0"
     ds = _dataset(rows, row_bytes, parallelism)
@@ -54,15 +68,21 @@ def run_one(op: str, rows: int, row_bytes: int, parallelism: int,
         ds = ds.random_shuffle(seed=7)
     total_bytes = 0
     total_rows = 0
+    h = hashlib.sha1()
     t0 = time.perf_counter()
     for ref in ds.iter_internal_refs():
         block = ray_tpu.get(ref)
         total_rows += block.num_rows
         total_bytes += block.nbytes
+        if block.num_rows:
+            col = _column_to_numpy(block.column(0))
+            if col.ndim > 1:
+                col = col[:, 0]
+            h.update(np.ascontiguousarray(col).tobytes())
     dt = time.perf_counter() - t0
     assert total_rows == rows, f"row loss: {total_rows} != {rows}"
     gbps_per_node = total_bytes / dt / 1e9 / max(1, nodes)
-    return round(gbps_per_node, 4), round(dt, 3), total_bytes
+    return round(gbps_per_node, 4), round(dt, 3), total_bytes, h.hexdigest()
 
 
 def main() -> int:
@@ -82,61 +102,87 @@ def main() -> int:
                     help="repetitions per (op, mode); best run is recorded "
                          "(this host class is heavily co-tenant)")
     ap.add_argument("--smoke", action="store_true",
-                    help="small fast preset (CI)")
+                    help="small fast preset (CI): reps=1 and asserts result "
+                         "equality across every (streaming, columnar) combo")
+    ap.add_argument("--columnar", choices=("on", "off", "both"), default="on",
+                    help="columnar zero-copy exchange A/B axis "
+                         "(RTPU_COLUMNAR_EXCHANGE); each setting runs in a "
+                         "fresh runtime since workers capture the flag at "
+                         "spawn")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
     if args.smoke:
         args.rows, args.row_bytes, args.parallelism = 50_000, 256, 8
+        args.reps = 1
+        args.columnar = "both"
 
     import ray_tpu
 
-    cluster = None
-    if args.cluster:
-        from ray_tpu.cluster import Cluster
-
-        cluster = Cluster(initialize_head=True,
-                          head_node_args={"num_cpus": 2})
-        for _ in range(max(0, args.nodes - 1)):
-            cluster.add_node(num_cpus=2)
-        cluster.wait_for_nodes(args.nodes, timeout=120)
-        ray_tpu.init(address=cluster.gcs_address)
-    else:
-        ray_tpu.init(num_cpus=8)
-
     dataset_bytes = args.rows * max(1, args.row_bytes // 8) * 8
     modes = ["barrier"] if args.no_streaming else ["streaming", "barrier"]
+    columnar_settings = (["on", "off"] if args.columnar == "both"
+                         else [args.columnar])
     results = {}
-    try:
-        # warmup: the first pipeline in a fresh runtime pays worker
-        # spin-up (~seconds); don't bill it to whichever mode runs first
-        run_one("shuffle", max(1000, args.rows // 50), args.row_bytes,
-                args.parallelism, args.nodes, streaming=True)
-        for op in [o.strip() for o in args.ops.split(",") if o.strip()]:
-            for mode in modes:
-                best = None
-                for _rep in range(max(1, args.reps)):
-                    gbps, secs, nbytes = run_one(
-                        op, args.rows, args.row_bytes, args.parallelism,
-                        args.nodes, streaming=(mode == "streaming"))
-                    if best is None or gbps > best[0]:
-                        best = (gbps, secs, nbytes)
-                gbps, secs, nbytes = best
-                metric = f"shuffle_{op}_{mode}_gbps_per_node"
-                print(json.dumps({
-                    "metric": metric, "value": gbps, "unit": "GB/s/node",
-                    "seconds": secs, "bytes": nbytes, "rows": args.rows,
-                    "nodes": args.nodes, "best_of": max(1, args.reps),
-                }))
-                results[metric] = {"gbps_per_node": gbps, "seconds": secs,
-                                   "bytes": nbytes}
-    finally:
-        ray_tpu.shutdown()
-        if cluster is not None:
-            cluster.shutdown()
+    digests = {}
+    for columnar in columnar_settings:
+        os.environ["RTPU_COLUMNAR_EXCHANGE"] = "1" if columnar == "on" else "0"
+        cluster = None
+        if args.cluster:
+            from ray_tpu.cluster import Cluster
+
+            cluster = Cluster(initialize_head=True,
+                              head_node_args={"num_cpus": 2})
+            for _ in range(max(0, args.nodes - 1)):
+                cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(args.nodes, timeout=120)
+            ray_tpu.init(address=cluster.gcs_address)
+        else:
+            ray_tpu.init(num_cpus=8)
+        try:
+            # warmup: the first pipeline in a fresh runtime pays worker
+            # spin-up (~seconds); don't bill it to whichever mode runs first
+            run_one("shuffle", max(1000, args.rows // 50), args.row_bytes,
+                    args.parallelism, args.nodes, streaming=True)
+            for op in [o.strip() for o in args.ops.split(",") if o.strip()]:
+                for mode in modes:
+                    best = None
+                    for _rep in range(max(1, args.reps)):
+                        gbps, secs, nbytes, digest = run_one(
+                            op, args.rows, args.row_bytes, args.parallelism,
+                            args.nodes, streaming=(mode == "streaming"))
+                        if best is None or gbps > best[0]:
+                            best = (gbps, secs, nbytes)
+                        digests[(op, mode, columnar)] = digest
+                    gbps, secs, nbytes = best
+                    suffix = "" if columnar == "on" else "_legacy"
+                    metric = f"shuffle_{op}_{mode}{suffix}_gbps_per_node"
+                    print(json.dumps({
+                        "metric": metric, "value": gbps, "unit": "GB/s/node",
+                        "seconds": secs, "bytes": nbytes, "rows": args.rows,
+                        "nodes": args.nodes, "best_of": max(1, args.reps),
+                        "columnar": columnar,
+                    }))
+                    results[metric] = {"gbps_per_node": gbps, "seconds": secs,
+                                       "bytes": nbytes}
+        finally:
+            ray_tpu.shutdown()
+            if cluster is not None:
+                cluster.shutdown()
+
+    # every (streaming, columnar) combo of an op must emit the same output
+    # sequence — the exchange path may never change results
+    for op in {k[0] for k in digests}:
+        combo = {k: v for k, v in digests.items() if k[0] == op}
+        if len(set(combo.values())) > 1:
+            print(f"RESULT MISMATCH for {op}: {combo}", file=sys.stderr)
+            return 1
+    if digests:
+        print(json.dumps({"result_equality": "ok",
+                          "combos": len(digests)}))
 
     if args.out:
         artifact = {
-            "round": 1,
+            "round": 2,
             "bench": "SHUFFLEBENCH",
             "host": f"{os.cpu_count()} vCPUs (shared/co-tenant class); "
                     "same-host loopback when --cluster — GB/s is CPU/"
@@ -150,10 +196,14 @@ def main() -> int:
                 "(first execution in a fresh runtime pays worker spin-up). "
                 "streaming vs barrier flips RTPU_STREAMING_SHUFFLE at plan "
                 "time (same cluster, same workers) so the delta is "
-                "exchange scheduling alone."
+                "exchange scheduling alone. columnar={col}: the columnar "
+                "zero-copy exchange (RTPU_COLUMNAR_EXCHANGE) runs each "
+                "setting in a fresh runtime (workers capture the flag at "
+                "spawn); _legacy metrics are the off side."
             ).format(rows=args.rows, rb=args.row_bytes, par=args.parallelism,
                      nodes=args.nodes, reps=max(1, args.reps),
-                     cl=" --cluster" if args.cluster else ""),
+                     cl=" --cluster" if args.cluster else "",
+                     col=args.columnar),
             "dataset_bytes": dataset_bytes,
             "results": results,
         }
