@@ -19,7 +19,8 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu.core import serialization
-from ray_tpu.core.config import columnar_exchange_enabled, config
+from ray_tpu.core.config import (columnar_exchange_enabled, config,
+                                 gcs_recovery_enabled)
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.resources import (
@@ -157,6 +158,17 @@ class ClusterRuntime(CoreRuntime):
         self._refop_buf: List[Tuple[str, Dict[str, Any]]] = []
         self._refop_event = threading.Event()
         self._refop_thread: Optional[threading.Thread] = None
+        # GCS crash-restart recovery (core/recovery/envelope.py): epoch
+        # observation rides the holder-heartbeat ack; the reconnect hook
+        # fires the catch-up (sealed-channel poll + ref re-assertion) the
+        # moment the client transparently re-dials a restarted GCS
+        from ray_tpu.core.recovery import RetryEnvelope
+
+        self._envelope = RetryEnvelope()
+        self._recovery_lock = threading.Lock()
+        if gcs_recovery_enabled():
+            self.gcs.add_reconnect_hook(
+                lambda: self._spawn_gcs_recovery("gcs client reconnected"))
         if self.pipelined:
             self._submit_flusher = threading.Thread(
                 target=self._submit_flush_loop, daemon=True,
@@ -945,7 +957,13 @@ class ClusterRuntime(CoreRuntime):
                 now = time.monotonic()
                 if now - self._last_holder_hb > min(2.5, config.object_holder_lease_s / 4):
                     self._last_holder_hb = now
-                    self.gcs.call("holder_heartbeat", holder=self.client_id)
+                    ack = self.gcs.call("holder_heartbeat",
+                                        holder=self.client_id)
+                    epoch = ack.get("epoch") if isinstance(ack, dict) else None
+                    if self._envelope.observe_epoch(epoch) \
+                            and gcs_recovery_enabled():
+                        self._spawn_gcs_recovery(
+                            f"gcs epoch bumped to {epoch}")
             except Exception:  # noqa: BLE001 - sync is advisory; retry next tick
                 pass
 
@@ -973,6 +991,60 @@ class ClusterRuntime(CoreRuntime):
                     object_ids=ids, holder=self.client_id,
                 )
                 i = j
+
+    # ----------------------------------------- GCS crash-restart catch-up
+    def _spawn_gcs_recovery(self, reason: str) -> None:
+        """Run the post-restart catch-up off-thread (the trigger sites — the
+        rpc client's reconnect hook and the ref flusher — must not block)."""
+        if self._shutting_down:
+            return
+        threading.Thread(target=self._gcs_restart_catchup, args=(reason,),
+                         daemon=True,
+                         name=f"gcs-catchup-{self.client_id[2:10]}").start()
+
+    def _gcs_restart_catchup(self, reason: str) -> None:
+        """Close the two gaps a GCS restart opens for THIS process:
+
+        - pushed ``sealed:`` events that fired while we were disconnected
+          are gone (the channel is re-subscribed, but pushes are not
+          replayed) — one catch-up ``wait_objects_located`` poll synthesizes
+          payload-less seal events for every pending return that already has
+          a location, unparking ``get()``/``wait()`` onto the ensure path;
+        - holder refs added after the last snapshot are missing from the
+          restored state — re-assert every id this process still holds so
+          the new incarnation's GC can't reap live objects.
+        """
+        if not self._recovery_lock.acquire(blocking=False):
+            return  # one catch-up at a time; the next epoch bump re-triggers
+        try:
+            logger.info("GCS restart catch-up (%s)", reason)
+            w = global_worker()
+            if w is not None:
+                held = w.ref_counter.live_ids()
+                for i in range(0, len(held), 500):
+                    self.gcs.call("add_object_refs",
+                                  object_ids=held[i:i + 500],
+                                  holder=self.client_id)
+            with self._seal_cond:
+                pending = [h for h in list(self._pending_task_returns)
+                           if h not in self._sealed_events]
+            if pending:
+                located = self.gcs.call(
+                    "wait_objects_located", object_ids=pending,
+                    num_returns=len(pending), timeout_s=0.0)
+                with self._seal_cond:
+                    for h in located or []:
+                        # payload-less synthetic event: get() stops waiting
+                        # for a push that already happened and reads the
+                        # object through the ensure path instead
+                        self._pending_task_returns.pop(h, None)
+                        self._sealed_events.setdefault(h, {"object_id": h})
+                    self._seal_cond.notify_all()
+        except Exception:  # noqa: BLE001 - catch-up is best-effort; the
+            # polling fallbacks (ensure path, holder lease renewal) converge
+            logger.exception("GCS restart catch-up failed")
+        finally:
+            self._recovery_lock.release()
 
     def on_borrowed_ref(self, ref: ObjectRef) -> None:
         """Deserializer hook: an ObjectRef materialized out of another object
@@ -1173,8 +1245,11 @@ class ClusterRuntime(CoreRuntime):
             "max_concurrency": spec.max_concurrency,
         }
         # The GCS owns actor scheduling AND restart (GcsActorScheduler
-        # equivalent); one call registers + schedules.
-        self.gcs.call(
+        # equivalent); one call registers + schedules. The envelope parks
+        # the call across a GCS outage (create_actor dedupes by actor_id at
+        # the GCS, so the re-send after a restart is harmless).
+        self._envelope.send(
+            self.gcs,
             "create_actor",
             spec=sd,
             class_name=spec.name.split(".")[0],

@@ -200,6 +200,27 @@ _DEFINITIONS = [
     ("prestart_workers", True, bool,
      "Start workers ahead of demand based on queue backlog."),
     # --- fault tolerance ---
+    ("gcs_recovery_enabled", True, bool,
+     "GCS crash-restart recovery subsystem (core/recovery/): a restarted "
+     "GCS stamps a new gcs_epoch, restores snapshot state, and rebuilds "
+     "the object directory from agent re-registration inside a bounded "
+     "reconstruction window; agents and drivers park-and-retry across the "
+     "outage instead of failing. Escape hatch: env RTPU_GCS_RECOVERY=0 "
+     "restores fail-fast behavior for A/B."),
+    ("gcs_reconstruction_window_s", 5.0, float,
+     "Upper bound on the post-restart reconstruction window: snapshot-"
+     "restored object locations stay provisional until the holder node "
+     "re-reports them; at the deadline unconfirmed locations are dropped "
+     "(so lost objects surface and lineage reconstruction can run). The "
+     "window also closes early once every provisional location is "
+     "confirmed or its node is dead."),
+    ("recovery_resync_batch", 200, int,
+     "Objects per batched register_objects RPC during an agent's full "
+     "re-registration (directory reconstruction after a GCS restart)."),
+    ("recovery_park_timeout_s", 60.0, float,
+     "How long recovery-aware paths (seal registration flush, transfer-"
+     "plane registration batcher) park-and-retry across a GCS outage "
+     "before failing their waiters."),
     ("task_max_retries_default", 3, int,
      "Default retries for tasks that die due to worker/node failure."),
     ("actor_max_restarts_default", 0, int,
@@ -372,6 +393,18 @@ def columnar_exchange_enabled() -> bool:
     if raw is not None:
         return raw.strip().lower() not in ("0", "false", "no", "off")
     return config.columnar_exchange_enabled
+
+
+def gcs_recovery_enabled() -> bool:
+    """GCS crash-restart recovery on/off. The RTPU_GCS_RECOVERY env var is
+    the operator escape hatch (tests and tools/bench_chaos.py set it) and
+    wins over the config entry so one process tree can be flipped wholesale:
+    with it off, a dead GCS fails agents and drivers fast (the pre-recovery
+    behavior) instead of parking-and-retrying through the outage."""
+    raw = os.environ.get("RTPU_GCS_RECOVERY")
+    if raw is not None:
+        return raw.strip().lower() not in ("0", "false", "no", "off")
+    return config.gcs_recovery_enabled
 
 
 def inline_max_bytes() -> int:

@@ -31,7 +31,8 @@ import random
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu.core.config import config
+from ray_tpu.core.config import config, gcs_recovery_enabled
+from ray_tpu.core.recovery.window import ReconstructionWindow
 from ray_tpu.core.rpc import RpcServer, loop_lag_watchdog, spawn
 from ray_tpu.utils.logging import get_logger
 
@@ -120,6 +121,14 @@ class GcsServer:
         # recently freed objects: a batched registration that raced the free
         # must not resurrect a directory record (entries expire in _gc_loop)
         self._freed_tombstones: Dict[str, float] = {}
+        # ---- crash-restart recovery (core/recovery/) ----
+        # Monotonic boot stamp persisted in the snapshot; every heartbeat /
+        # register ack carries it, which is how agents and drivers detect a
+        # restart and replay their registrations against THIS incarnation.
+        self.gcs_epoch = 1
+        self.recovery_window: Optional[ReconstructionWindow] = None
+        self._recovery_task: Optional[asyncio.Task] = None
+        self._resyncs_seen = 0  # full node re-registrations this incarnation
 
     async def start(self) -> Tuple[str, int]:
         host, port = await self.rpc.start()
@@ -131,6 +140,8 @@ class GcsServer:
         if self._storage is not None:
             self._restore_snapshot()
             self._persist_task = spawn(self._persist_loop())
+        if self.recovery_window is not None and self.recovery_window.open:
+            self._recovery_task = spawn(self.recovery_window.run(self))
         self._health_task = spawn(self._health_loop())
         self._gc_task = spawn(self._gc_loop())
         self._watchdog_task = spawn(loop_lag_watchdog("gcs"))
@@ -149,6 +160,8 @@ class GcsServer:
             self._health_task.cancel()
         if self._gc_task:
             self._gc_task.cancel()
+        if self._recovery_task:
+            self._recovery_task.cancel()
         if getattr(self, "_watchdog_task", None):
             self._watchdog_task.cancel()
         if self._external:
@@ -179,10 +192,14 @@ class GcsServer:
         self.last_heartbeat[node_id] = time.monotonic()
         # fresh incarnation: its first heartbeat must carry a full view
         self._node_sync_version.pop(node_id, None)
+        if self.recovery_window is not None:
+            self.recovery_window.node_registered(node_id)
+            self._resyncs_seen += 1
         if self._external:
             self._external.add_node(node_id, resources)
         await self.rpc.publish("nodes", {"event": "register", "node": self.nodes[node_id]})
-        return {"system_config": dict_config_snapshot()}
+        return {"system_config": dict_config_snapshot(),
+                "gcs_epoch": self.gcs_epoch}
 
     async def rpc_heartbeat(
         self, node_id: str, available: Optional[Dict[str, float]] = None,
@@ -195,7 +212,9 @@ class GcsServer:
         of the full resource/load maps, which is what keeps 2,000-node
         heartbeat fan-in off the GCS loop. A version mismatch (GCS restarted
         from an older snapshot) answers {"resync": True} and the agent
-        re-sends the full view next tick."""
+        re-sends the full view next tick. Every ack carries ``gcs_epoch``:
+        an agent observing a bump runs its full re-registration
+        (core/recovery/resync.py) against this incarnation."""
         info = self.nodes.get(node_id)
         if info is None or not info.get("Alive", False):
             # unknown (GCS restarted) OR marked dead (reaped during a
@@ -203,17 +222,18 @@ class GcsServer:
             # node's heartbeats would leave it unschedulable forever
             return False
         self.last_heartbeat[node_id] = time.monotonic()
+        ack = {"ok": True, "epoch": self.gcs_epoch}
         if available is None:
             # delta ping: valid only if we hold this version's full view
             if version is not None and \
                     self._node_sync_version.get(node_id) != version:
-                return {"ok": True, "resync": True}
-            return True
+                return {**ack, "resync": True}
+            return ack
         self.available[node_id] = dict(available)
         self.node_load[node_id] = dict(load or {})
         if version is not None:
             self._node_sync_version[node_id] = version
-        return True
+        return ack
 
     async def rpc_publish_worker_logs(self, node_id: str, worker_id: str,
                                       lines: List[str],
@@ -283,6 +303,10 @@ class GcsServer:
             return
         info["Alive"] = False
         self.available.pop(node_id, None)
+        if self.recovery_window is not None:
+            # its provisional locations are being dropped right below; the
+            # sweep has nothing left to decide about this node
+            self.recovery_window.node_dead(node_id)
         # a held version must always imply a held full view (and a future
         # incarnation must never match this one's version)
         self._node_sync_version.pop(node_id, None)
@@ -677,9 +701,14 @@ class GcsServer:
         restart (reference: GcsActorManager + GcsActorScheduler,
         gcs_actor_scheduler.cc:49 Schedule / restart on worker death)."""
         actor_id = spec["actor_id"]
+        if actor_id in self.actors:
+            # idempotent by actor_id: a parked driver retry after a GCS
+            # restart (or a transparently re-sent frame) must not double-
+            # schedule or trip its own name reservation
+            return True
         if name:
             key = (namespace, name)
-            if key in self.named_actors:
+            if key in self.named_actors and self.named_actors[key] != actor_id:
                 raise ValueError(f"Actor name '{name}' already taken in namespace '{namespace}'")
             self.named_actors[key] = actor_id
         self.actors[actor_id] = {
@@ -901,6 +930,10 @@ class GcsServer:
         rec["size"] = size
         rec["locations"].add(node_id)
         rec["had_locations"] = True
+        if self.recovery_window is not None:
+            # an agent re-reporting a copy confirms the snapshot-restored
+            # provisional (object, node) pair as authoritative
+            self.recovery_window.confirm(object_id, node_id)
         self._wake_object_waiters(object_id)
         if contained:
             # ObjectRefs serialized INSIDE this object: the container holds
@@ -967,9 +1000,16 @@ class GcsServer:
             "owner": rec["owner"],
             # lost = every copy was on since-dead nodes: the value is gone and
             # only lineage reconstruction (owner resubmits the producing task)
-            # can bring it back — waiting won't (object_recovery_manager.h:41)
-            "lost": not rec["locations"] and rec.get("had_locations", False),
+            # can bring it back — waiting won't (object_recovery_manager.h:41).
+            # Suppressed inside the reconstruction window: a provisional
+            # object with zero confirmed copies may be re-reported any tick,
+            # and a premature loss signal fires spurious re-executions.
+            "lost": (not rec["locations"] and rec.get("had_locations", False)
+                     and not self._reconstruction_open()),
         }
+
+    def _reconstruction_open(self) -> bool:
+        return self.recovery_window is not None and self.recovery_window.open
 
     async def rpc_lookup_objects(
         self, object_ids: List[str]
@@ -1052,6 +1092,7 @@ class GcsServer:
                 if rec is not None and (rec["locations"] or (
                     include_lost and not rec["locations"]
                     and rec.get("had_locations", False)
+                    and not self._reconstruction_open()
                 )):
                     out.append(object_id)
             return out
@@ -1121,9 +1162,11 @@ class GcsServer:
             await self.rpc_remove_object_refs(u["object_ids"], u["holder"])
         return True
 
-    async def rpc_holder_heartbeat(self, holder: str) -> bool:
+    async def rpc_holder_heartbeat(self, holder: str) -> Dict[str, Any]:
         self.holder_last_seen[holder] = time.monotonic()
-        return True
+        # the ack carries the GCS incarnation: a driver has no node heartbeat,
+        # so its ref flusher's lease renewal doubles as epoch observation
+        return {"ok": True, "epoch": self.gcs_epoch}
 
     async def rpc_remove_object_refs(self, object_ids: List[str], holder: str) -> bool:
         now = time.monotonic()
@@ -1420,6 +1463,7 @@ class GcsServer:
             "lineage": {o: dict(v) for o, v in self.lineage.items()},
             "pgs": {p: dict(v) for p, v in self.pgs.items()},
             "job_counter": self._job_counter,
+            "gcs_epoch": self.gcs_epoch,
         }
 
     def _write_snapshot(self, state: Dict[str, Any]) -> None:
@@ -1454,6 +1498,13 @@ class GcsServer:
         self.lineage = s.get("lineage", {})
         self.pgs = s.get("pgs", {})
         self._job_counter = s.get("job_counter", 1)
+        # new incarnation: every epoch observer (agent heartbeats, driver
+        # holder_heartbeat acks) sees the bump and triggers its resync
+        self.gcs_epoch = s.get("gcs_epoch", 0) + 1
+        if gcs_recovery_enabled():
+            # restored directory/node state is authoritative-but-stale until
+            # agents re-report it; the window bounds how long we wait
+            self.recovery_window = ReconstructionWindow(self.objects, self.nodes)
         # nodes must prove liveness again: stamp now so the health loop gives
         # them a full window to heartbeat before declaring them dead
         now = time.monotonic()
@@ -1469,9 +1520,17 @@ class GcsServer:
             for holder in holders:
                 if holder.startswith("w:"):
                     self.holder_last_seen.setdefault(holder, now)
+        # a PENDING actor restored from the snapshot has no scheduling loop
+        # (its driver's create_actor retry dedupes by actor_id and returns
+        # without re-scheduling): restart placement for it here
+        for actor_id, rec in self.actors.items():
+            if rec.get("state") == "PENDING":
+                spawn(self._schedule_actor(actor_id))
         logger.info(
-            "restored GCS snapshot: %d nodes, %d actors, %d objects, %d kv",
+            "restored GCS snapshot: %d nodes, %d actors, %d objects, %d kv "
+            "(epoch %d)",
             len(self.nodes), len(self.actors), len(self.objects), len(self.kv),
+            self.gcs_epoch,
         )
 
     async def _persist_loop(self) -> None:
@@ -1501,6 +1560,15 @@ class GcsServer:
             "schedule_calls": self._schedule_calls,
             "schedule_requests": self._schedule_reqs,
             "uptime_s": time.time() - self._started_at,
+            "gcs_epoch": self.gcs_epoch,
+            "recovery": {
+                "window_open": self._reconstruction_open(),
+                "provisional": (self.recovery_window.remaining()
+                                if self.recovery_window is not None else 0),
+                "converged_in_s": (self.recovery_window.converged_in_s
+                                   if self.recovery_window is not None else 0.0),
+                "resyncs": self._resyncs_seen,
+            },
         }
 
 

@@ -74,14 +74,30 @@ class FileSnapshotBackend(GcsStorageBackend):
         tmp = f"{path}.{os.getpid()}.{id(state):x}.tmp"
         with open(tmp, "wb") as f:
             f.write(self._encode(state))
+        # keep the previous generation: rename is atomic but the published
+        # file can still end up unreadable (disk-full truncation, fs bugs,
+        # a crash between the rename and a later page flush); load() falls
+        # back to .prev so a SIGKILL'd GCS restarts from the last-but-one
+        # snapshot instead of fresh
+        if os.path.exists(path):
+            try:
+                os.replace(path, f"{path}.prev")
+            except OSError:
+                pass
         os.replace(tmp, path)  # atomic: readers never see a torn snapshot
 
     def load(self) -> Optional[Dict[str, Any]]:
         path = self._path()
-        if not os.path.exists(path):
-            return None
-        with open(path, "rb") as f:
-            return self._decode(f.read())
+        for candidate in (path, f"{path}.prev"):
+            if not os.path.exists(candidate):
+                continue
+            try:
+                with open(candidate, "rb") as f:
+                    return self._decode(f.read())
+            except Exception:  # noqa: BLE001 - corrupt generation: try older
+                logger.exception("unreadable snapshot %s; trying previous",
+                                 candidate)
+        return None
 
 
 class SqliteBackend(GcsStorageBackend):

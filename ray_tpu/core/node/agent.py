@@ -28,7 +28,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu.core.config import config, raw_transfer_enabled
+from ray_tpu.core.config import config, gcs_recovery_enabled, raw_transfer_enabled
 from ray_tpu.core.ids import NodeID, ObjectID
 from ray_tpu.core.node.transfer import TransferManager
 from ray_tpu.core.rpc import (RawResult, RpcClient, RpcConnectionError,
@@ -190,6 +190,17 @@ class NodeAgent:
         self._hb_full_pending = True
         self._hb_last_view: Optional[tuple] = None
         self._supervise_task: Optional[asyncio.Task] = None
+        # GCS crash-restart recovery (core/recovery/resync.py): last epoch
+        # observed on a heartbeat ack; a bump means a new GCS incarnation and
+        # triggers a full re-registration of node/objects/actors/pins
+        self._last_gcs_epoch: Optional[int] = None
+        self._resync_task: Optional[asyncio.Task] = None
+        self._resync_rerun = False
+        self._resyncs = 0
+        # task_holder -> pin kwargs of tasks still in flight on this node;
+        # the resync re-asserts these leases so a restarted GCS can't reap
+        # in-progress returns that were pinned after its last snapshot
+        self._active_pins: Dict[str, Dict[str, Any]] = {}
         self._pull_locks: Dict[str, asyncio.Lock] = {}
         self._recon_locks: Dict[str, asyncio.Lock] = {}
         self._recon_attempts: Dict[str, int] = {}
@@ -231,7 +242,7 @@ class NodeAgent:
     async def start(self) -> Tuple[str, int]:
         host, port = await self.rpc.start()
         self.gcs = await RpcClient(self.gcs_address).connect()
-        await self.gcs.call(
+        resp = await self.gcs.call(
             "register_node",
             node_id=self.hex,
             address=self.rpc.address,
@@ -239,6 +250,8 @@ class NodeAgent:
             labels=self.labels,
             is_head=self.is_head,
         )
+        if isinstance(resp, dict):
+            self._last_gcs_epoch = resp.get("gcs_epoch")
         await self.gcs.subscribe("nodes", self._on_node_event)
         self._hb_task = spawn(self._heartbeat_loop())
         self._supervise_task = spawn(self._supervise_loop())
@@ -277,7 +290,7 @@ class NodeAgent:
             await self.dashboard.stop()
         for t in (self._hb_task, self._supervise_task, self._memory_task,
                   self._pin_flusher, self._reg_flusher, self._unpin_flusher,
-                  self._log_monitor_task,
+                  self._log_monitor_task, self._resync_task,
                   getattr(self, "_watchdog_task", None)):
             if t:
                 t.cancel()
@@ -422,19 +435,40 @@ class NodeAgent:
                     **kwargs,
                 )
                 if ok is False:
-                    await self.gcs.call(
-                        "register_node",
-                        node_id=self.hex,
-                        address=self.rpc.address,
-                        resources=self.total_resources,
-                        labels=self.labels,
-                        is_head=self.is_head,
-                    )
+                    # restarted GCS with no (or a pre-us) snapshot: it lost
+                    # this node entirely — full re-registration, not just
+                    # register_node (our objects/actors/pins are gone too)
+                    if gcs_recovery_enabled():
+                        from ray_tpu.core.recovery import trigger_resync
+
+                        trigger_resync(self, "heartbeat rejected: GCS lost "
+                                             "this node")
+                    else:
+                        await self.gcs.call(
+                            "register_node",
+                            node_id=self.hex,
+                            address=self.rpc.address,
+                            resources=self.total_resources,
+                            labels=self.labels,
+                            is_head=self.is_head,
+                        )
                     self._hb_full_pending = True  # fresh GCS: resend view
                 elif isinstance(ok, dict) and ok.get("resync"):
                     self._hb_full_pending = True  # GCS lost our version
                 else:
                     self._hb_full_pending = False
+                if isinstance(ok, dict):
+                    epoch = ok.get("epoch")
+                    if (epoch is not None and gcs_recovery_enabled()
+                            and self._last_gcs_epoch is not None
+                            and epoch != self._last_gcs_epoch):
+                        from ray_tpu.core.recovery import trigger_resync
+
+                        self._last_gcs_epoch = epoch
+                        trigger_resync(
+                            self, f"GCS epoch bumped to {epoch}")
+                    elif epoch is not None:
+                        self._last_gcs_epoch = epoch
             except (RpcConnectionError, TimeoutError):
                 logger.warning("heartbeat to GCS failed")
                 self._hb_full_pending = True
@@ -896,19 +930,48 @@ class NodeAgent:
             batch, self._reg_queue = self._reg_queue, []
             if not batch:
                 continue
-            try:
-                await self.gcs.call("register_objects",
-                                    regs=[r for r, _ in batch])
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_result(True)
-            except Exception as e:  # noqa: BLE001 - GCS hiccup: fail seals
-                logger.exception("register_objects flush failed")
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
-                        fut.exception()  # sealer may have gone: mark seen
-                await asyncio.sleep(0.2)
+            parked_until: Optional[float] = None
+            while True:
+                try:
+                    await self.gcs.call("register_objects",
+                                        regs=[r for r, _ in batch])
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_result(True)
+                    break
+                except (RpcConnectionError, TimeoutError) as e:
+                    # GCS outage: PARK the batch and re-send once the
+                    # restarted GCS answers — "sealed implies registered"
+                    # must hold across a crash-restart, so pending seal acks
+                    # wait instead of failing their tasks. register_objects
+                    # is idempotent on the GCS side, so a duplicate re-send
+                    # after an ambiguous timeout is harmless.
+                    if not gcs_recovery_enabled():
+                        self._fail_reg_batch(batch, e)
+                        await asyncio.sleep(0.2)
+                        break
+                    now = time.monotonic()
+                    if parked_until is None:
+                        parked_until = now + config.recovery_park_timeout_s
+                        logger.warning("register_objects parked across GCS "
+                                       "outage (%d seals pending)", len(batch))
+                    if now >= parked_until:
+                        self._fail_reg_batch(batch, e)
+                        break
+                    await asyncio.sleep(0.2)
+                except Exception as e:  # noqa: BLE001 - remote error: fail seals
+                    logger.exception("register_objects flush failed")
+                    self._fail_reg_batch(batch, e)
+                    await asyncio.sleep(0.2)
+                    break
+
+    @staticmethod
+    def _fail_reg_batch(batch: List[Tuple[Dict[str, Any], asyncio.Future]],
+                        e: Exception) -> None:
+        for _, fut in batch:
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()  # sealer may have gone: mark seen
 
     async def _unpin_flush_loop(self) -> None:
         while True:
@@ -1692,6 +1755,10 @@ class NodeAgent:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pin_queue.append((pin, fut))
         self._pin_event.set()
+        # tracked while the task is in flight so a GCS-restart resync can
+        # re-assert the lease (pins taken after the last snapshot are gone
+        # from the restored state)
+        self._active_pins[pin["task_holder"]] = pin
         return fut
 
     async def rpc_submit_task_batch(self, specs: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -1737,6 +1804,7 @@ class NodeAgent:
             # submitter's holder; deps fall back to their own holders.
             # Rides the batched unpin flush (one GCS RPC per tick).
             pinned = (spec.get("deps") or []) + (spec.get("returns") or [])
+            self._active_pins.pop(self._task_holder(spec), None)
             if pinned:
                 self._unpin_queue.append({
                     "holder": self._task_holder(spec), "object_ids": pinned,

@@ -159,16 +159,43 @@ class _RegistrationBatcher:
             if not pending:
                 continue
             self.batches_sent += 1
-            try:
-                await self.agent.gcs.call("register_objects", regs=pending)
-            except BaseException as e:  # noqa: BLE001 - fan the failure out
-                for fut in waiters:
-                    if not fut.done():
-                        fut.set_exception(e)
-                continue
-            for fut in waiters:
-                if not fut.done():
-                    fut.set_result(True)
+            parked_until: Optional[float] = None
+            while True:
+                try:
+                    await self.agent.gcs.call("register_objects", regs=pending)
+                    for fut in waiters:
+                        if not fut.done():
+                            fut.set_result(True)
+                    break
+                except (RpcConnectionError, TimeoutError) as e:
+                    # GCS restarted mid-drain: PARK the batch and re-send
+                    # against the new incarnation instead of failing every
+                    # waiter's pull/ingest (register_objects is idempotent,
+                    # so an ambiguous timeout re-send is harmless)
+                    from ray_tpu.core.config import gcs_recovery_enabled
+
+                    if not gcs_recovery_enabled():
+                        self._fail_waiters(waiters, e)
+                        break
+                    now = time.monotonic()
+                    if parked_until is None:
+                        parked_until = now + config.recovery_park_timeout_s
+                        logger.warning(
+                            "transfer registration batch parked across GCS "
+                            "outage (%d objects)", len(pending))
+                    if now >= parked_until:
+                        self._fail_waiters(waiters, e)
+                        break
+                    await asyncio.sleep(0.2)
+                except BaseException as e:  # noqa: BLE001 - fan the failure out
+                    self._fail_waiters(waiters, e)
+                    break
+
+    @staticmethod
+    def _fail_waiters(waiters: List[asyncio.Future], e: BaseException) -> None:
+        for fut in waiters:
+            if not fut.done():
+                fut.set_exception(e)
 
 
 class TransferManager:

@@ -39,7 +39,7 @@ import itertools
 import random
 import struct
 import threading
-from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
@@ -234,6 +234,10 @@ RETRY_SAFE_METHODS = frozenset({
     "holder_heartbeat", "get_lineage",
     "get_actor", "get_actor_spec", "get_named_actor", "list_named_actors",
     "list_actors", "actor_started", "placement_group_info",
+    # create_actor dedupes by driver-supplied actor_id at the GCS (an
+    # already-registered id returns True without re-scheduling), so a
+    # re-send after an ambiguous timeout or a GCS restart is harmless
+    "create_actor",
     "placement_group_table", "reserve_bundle", "return_bundle",
     # create dedupes by pg_id at the GCS (first attempt wins); remove's
     # second attempt no-ops on the already-popped record
@@ -531,6 +535,10 @@ class RpcClient:
         self._ids = itertools.count(1)
         self._read_task: Optional[asyncio.Task] = None
         self._sub_callbacks: Dict[str, Callable[[Any], None]] = {}
+        # sync callables fired after every successful _reconnect (channels
+        # already re-subscribed): the hook point for catch-up work a push
+        # channel silently missed during the outage (e.g. sealed events)
+        self._reconnect_hooks: List[Callable[[], None]] = []
         self._send_lock: Optional[asyncio.Lock] = None
         self._reconnect_lock: Optional[asyncio.Lock] = None
         self._conn_gen = 0
@@ -761,6 +769,14 @@ class RpcClient:
                     await self._call_once("__subscribe__", 2.0, {"channel": channel})
                 except (TimeoutError, RpcConnectionError):
                     pass
+            for hook in list(self._reconnect_hooks):
+                try:
+                    hook()
+                except Exception:  # noqa: BLE001 - catch-up must not kill reconnect
+                    logger.exception("reconnect hook failed")
+
+    def add_reconnect_hook(self, hook: Callable[[], None]) -> None:
+        self._reconnect_hooks.append(hook)
 
     async def _call_once(self, method: str, timeout: Optional[float], params: Dict) -> Any:
         if self._closed:
@@ -897,6 +913,12 @@ class SyncRpcClient:
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
         self._run(self._client.subscribe(channel, callback))
+
+    def add_reconnect_hook(self, hook: Callable[[], None]) -> None:
+        """``hook()`` runs on the client loop thread after every successful
+        transparent reconnect (subscriptions already restored) — keep it
+        non-blocking; spawn a thread for real catch-up work."""
+        self._client.add_reconnect_hook(hook)
 
     def unsubscribe(self, channel: str) -> None:
         self._run(self._client.unsubscribe(channel))

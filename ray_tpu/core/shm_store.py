@@ -469,6 +469,13 @@ class ShmObjectStore:
             e = self._entries.get(oid)
             return (e.size, e.sealed) if e else None
 
+    def sealed_items(self) -> List[Tuple[ObjectID, int]]:
+        """(oid, size) of every sealed object — the agent's re-registration
+        source of truth after a GCS restart."""
+        with self._lock:
+            return [(oid, e.size) for oid, e in self._entries.items()
+                    if e.sealed]
+
     def offset(self, oid: ObjectID) -> Optional[int]:
         """Arena payload offset for a local (non-spilled) object, else None."""
         with self._lock:

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 from collections import defaultdict
-from typing import Dict, Optional, TYPE_CHECKING
+from typing import Dict, List, Optional, TYPE_CHECKING
 
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 
@@ -61,6 +61,13 @@ class ReferenceCounter:
                     fire = True
         if fire and self._on_zero is not None:
             self._on_zero(oid)
+
+    def live_ids(self) -> List[str]:
+        """Hex ids of every object this process still holds (local refs or
+        pending submissions) — what a GCS-restart catch-up re-asserts."""
+        with self._lock:
+            return [oid.hex() for oid in
+                    set(self._local) | set(self._submitted)]
 
     def add_submitted(self, oid: ObjectID) -> None:
         with self._lock:

@@ -68,6 +68,11 @@ def pytest_configure(config):
         "slow: multi-GB / long-running benches excluded from the tier-1 "
         "run (-m 'not slow')",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (SIGKILLed components, dropped "
+        "frames). Tier-1 — selectable with -m chaos for focused runs.",
+    )
 
 
 @pytest.hookimpl(wrapper=True)

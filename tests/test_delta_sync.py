@@ -4,6 +4,13 @@
 import asyncio
 
 
+def _acked(out):
+    """Happy ack: since the recovery subsystem, acks are dicts carrying the
+    GCS epoch (no resync demand)."""
+    return isinstance(out, dict) and out["ok"] and not out.get("resync") \
+        and out["epoch"] >= 1
+
+
 def test_heartbeat_delta_protocol():
     from ray_tpu.core.gcs.server import GcsServer
 
@@ -14,20 +21,20 @@ def test_heartbeat_delta_protocol():
             await g.rpc_register_node(node_id="n1", address="x:1",
                                       resources={"CPU": 4.0}, labels={})
             # full view at version 1
-            assert await g.rpc_heartbeat(node_id="n1", version=1,
-                                         available={"CPU": 3.0},
-                                         load={"dispatching": 1}) is True
+            assert _acked(await g.rpc_heartbeat(node_id="n1", version=1,
+                                                available={"CPU": 3.0},
+                                                load={"dispatching": 1}))
             assert g.available["n1"] == {"CPU": 3.0}
             # unchanged view: bare ping with the same version
-            assert await g.rpc_heartbeat(node_id="n1", version=1) is True
+            assert _acked(await g.rpc_heartbeat(node_id="n1", version=1))
             # ping with a version the GCS never saw in full -> resync request
             out = await g.rpc_heartbeat(node_id="n1", version=2)
             assert isinstance(out, dict) and out["resync"]
             # full resend at version 2 heals it
-            assert await g.rpc_heartbeat(node_id="n1", version=2,
-                                         available={"CPU": 1.0}) is True
+            assert _acked(await g.rpc_heartbeat(node_id="n1", version=2,
+                                                available={"CPU": 1.0}))
             assert g.available["n1"] == {"CPU": 1.0}
-            assert await g.rpc_heartbeat(node_id="n1", version=2) is True
+            assert _acked(await g.rpc_heartbeat(node_id="n1", version=2))
             # unknown node (GCS restart without snapshot) -> re-register
             assert await g.rpc_heartbeat(node_id="ghost", version=1) is False
         finally:
@@ -47,8 +54,8 @@ def test_dead_node_heartbeat_forces_reregister():
         try:
             await g.rpc_register_node(node_id="n1", address="x:1",
                                       resources={"CPU": 4.0}, labels={})
-            assert await g.rpc_heartbeat(node_id="n1", version=1,
-                                         available={"CPU": 4.0}) is True
+            assert _acked(await g.rpc_heartbeat(node_id="n1", version=1,
+                                                available={"CPU": 4.0}))
             await g._mark_node_dead("n1", "missed heartbeats")
             assert "n1" not in g._node_sync_version  # version dropped
             # both bare pings and full views now force re-registration

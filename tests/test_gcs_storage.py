@@ -48,3 +48,47 @@ def test_gcs_server_with_sqlite_uri(tmp_path):
         return v
 
     assert asyncio.run(run()) == b"v1"
+
+
+def test_named_actor_registry_survives_gcs_restart(tmp_path):
+    """The named-actor registry and each actor's restart budget persist in
+    the snapshot: after a GCS stop/start, a named ``get_actor`` lookup still
+    resolves and ``restarts``/``max_restarts`` carry over (a restarted GCS
+    must not grant a failing actor a fresh restart allowance)."""
+    import asyncio
+
+    from ray_tpu.core.gcs.server import GcsServer
+
+    aid = "ac" * 16
+
+    async def run():
+        g = GcsServer(port=0, persist_dir=str(tmp_path))
+        await g.start()
+        await g.rpc_create_actor(
+            spec={"actor_id": aid, "resources": {}, "returns": []},
+            class_name="Counter", name="counter", namespace="ns1",
+            max_restarts=3)
+        g.actors[aid].update(state="ALIVE", restarts=2)
+        # duplicate create (parked driver retry): dedupes by actor_id, does
+        # not reset state or trip the name reservation
+        assert await g.rpc_create_actor(
+            spec={"actor_id": aid, "resources": {}, "returns": []},
+            class_name="Counter", name="counter", namespace="ns1",
+            max_restarts=3) is True
+        assert g.actors[aid]["restarts"] == 2
+        g._write_snapshot(g._snapshot_state())
+        await g.stop()
+
+        g2 = GcsServer(port=0, persist_dir=str(tmp_path))
+        await g2.start()
+        try:
+            assert await g2.rpc_get_named_actor("counter", "ns1") == aid
+            rec = await g2.rpc_get_actor(aid)
+            assert rec is not None
+            assert rec["restarts"] == 2 and rec["max_restarts"] == 3
+            # unknown name still misses (registry restored, not invented)
+            assert await g2.rpc_get_named_actor("counter", "other") is None
+        finally:
+            await g2.stop()
+
+    asyncio.run(run())
